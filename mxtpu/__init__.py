@@ -1,0 +1,57 @@
+"""mxtpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+A ground-up rebuild of the Apache MXNet 1.x surface (reference:
+yuantangliang/incubator-mxnet) on the JAX/XLA/Pallas stack:
+
+- ``mx.nd`` imperative arrays  → jax.Array + async PJRT dispatch
+- ``mx.autograd``              → tape over jax.vjp
+- ``mx.gluon`` + hybridize()   → jax.jit whole-graph compilation
+- ``mx.kv`` KVStore            → XLA collectives over the ICI mesh
+- ``mx.sym`` Symbol            → lazy tracer lowering to the same ops
+
+Typical use, unchanged from the reference except the context::
+
+    import mxtpu as mx
+    net.initialize(ctx=mx.tpu())
+"""
+from . import base
+
+# Dtype policy (TPU-native): 64-bit dtypes are demoted to 32-bit by default
+# — float64 has no TPU hardware path and int64 indexing costs bandwidth.
+# Set MXNET_ENABLE_X64=1 before import for full 64-bit support (CPU workflows,
+# the reference's large-tensor mode; tests/conftest.py enables it).
+if base.env_bool("MXNET_ENABLE_X64", False,
+                 "Enable 64-bit dtypes (jax_enable_x64)."):
+    import jax as _jax
+    _jax.config.update("jax_enable_x64", True)
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from .ndarray import random
+from . import autograd
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # heavier subsystems load lazily to keep `import mxtpu` fast
+    import importlib
+    lazy = {"gluon", "optimizer", "metric", "initializer", "lr_scheduler",
+            "callback", "kvstore", "io", "image", "symbol", "profiler",
+            "test_utils", "util", "runtime", "recordio", "np", "npx",
+            "sym", "model", "engine", "parallel", "models", "ops",
+            "utils", "amp", "contrib", "rnn", "serde"}
+    if name in lazy:
+        mod = {"sym": "mxtpu.symbol", "np": "mxtpu.numpy",
+               "npx": "mxtpu.numpy_extension"}.get(name, f"mxtpu.{name}")
+        m = importlib.import_module(mod)
+        globals()[name] = m
+        return m
+    if name == "kv":
+        m = importlib.import_module("mxtpu.kvstore")
+        globals()["kv"] = m
+        return m
+    raise AttributeError(f"module 'mxtpu' has no attribute {name!r}")

@@ -1,0 +1,119 @@
+"""Foundation utilities: dtype handling, env-var config registry, errors.
+
+TPU-native rebuild of the roles played in the reference by
+``python/mxnet/base.py`` (ctypes glue — not needed here: the "C ABI" of
+this framework is jaxlib/PJRT, already C++) and the env-var config tier
+documented in the reference's ``docs/faq/env_var.md`` [path cite].
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "dtype_np",
+    "dtype_name",
+    "env_int",
+    "env_bool",
+    "env_str",
+    "registered_env_vars",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: ``dmlc::Error`` surfaced via
+    ``MXGetLastError``, ``src/c_api/c_api_error.cc`` [path cite])."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# Canonical dtype table. bfloat16 is first-class on TPU (the reference's
+# float16 story lives in 3rdparty/mshadow/mshadow/half.h + bfloat.h).
+_DTYPE_ALIASES: Dict[str, str] = {
+    "float32": "float32",
+    "float64": "float64",
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "uint8": "uint8",
+    "int8": "int8",
+    "int32": "int32",
+    "int64": "int64",
+    "bool": "bool",
+}
+
+
+def dtype_np(dtype: Any) -> _np.dtype:
+    """Normalize a dtype-ish value (str, np.dtype, jnp dtype, None) to np.dtype."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            return _np.dtype(ml_dtypes.bfloat16)
+        return _np.dtype(_DTYPE_ALIASES.get(dtype, dtype))
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype: Any) -> str:
+    """Printable dtype name ('float32', 'bfloat16', ...)."""
+    return _np.dtype(dtype).name
+
+
+# ---------------------------------------------------------------------------
+# Env-var config registry — the rebuild's analogue of the ~80 MXNET_* env
+# vars read via dmlc::GetEnv and documented in docs/faq/env_var.md.
+# Every knob is registered so `mxtpu.base.registered_env_vars()` is the
+# single documented registry (SURVEY.md §5.6 rebuild mapping).
+# ---------------------------------------------------------------------------
+_ENV_REGISTRY: Dict[str, Dict[str, Any]] = {}
+
+
+def _register(name: str, default: Any, doc: str) -> None:
+    _ENV_REGISTRY.setdefault(name, {"default": default, "doc": doc})
+
+
+def env_int(name: str, default: int, doc: str = "") -> int:
+    _register(name, default, doc)
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool, doc: str = "") -> bool:
+    _register(name, default, doc)
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.lower() not in ("0", "false", "off", "")
+
+
+def env_str(name: str, default: str, doc: str = "") -> str:
+    _register(name, default, doc)
+    return os.environ.get(name, default)
+
+
+def registered_env_vars() -> Dict[str, Dict[str, Any]]:
+    """All env vars the framework reads, with defaults and docs."""
+    return dict(_ENV_REGISTRY)
+
+
+# Commonly-consulted knobs registered eagerly so they always appear in the
+# registry even before first use.
+env_str("MXNET_ENGINE_TYPE", "ThreadedEngine",
+        "Execution mode: 'NaiveEngine' forces block_until_ready after every "
+        "op (sync debugging, reference src/engine/naive_engine.cc analogue); "
+        "default relies on XLA async dispatch.")
+env_bool("MXNET_SAFE_ACCUMULATION", True,
+         "Accumulate reductions of low-precision dtypes in float32.")
+env_int("MXNET_TEST_SEED", -1, "Fixed seed for the test suite (-1 = random).")
+env_str("MXNET_TEST_DEVICE", "", "Device for default_context() in tests.")

@@ -1,0 +1,9 @@
+"""``mx.nd`` — the imperative NDArray API (reference python/mxnet/ndarray/)."""
+from .ndarray import (NDArray, array, empty, zeros, ones, full, arange,
+                      concat, stack, waitall, from_jax, save, load)
+from .ops import *  # noqa: F401,F403  — registered op namespace
+from .ops import OP_REGISTRY, register_op
+from . import random
+
+# `mx.nd.zeros_like(x)` style helpers already come from ops; keep module
+# surface aligned with the reference's generated namespace.

@@ -1,0 +1,885 @@
+"""The ``mx.nd.*`` operator namespace, backed by jnp/lax/jax.nn.
+
+Rebuild of the reference operator library (``src/operator/`` — tensor/,
+nn/, elemwise, broadcast, reductions [path cite]) as compositions of XLA
+ops. Each op is registered in ``OP_REGISTRY`` (name → raw jax fn factory)
+so the Symbol tracer and CachedOp replay can reuse the exact same kernels
+— the analogue of the NNVM op registry + FCompute dispatch
+(include/mxnet/op_attr_types.h).
+
+Every op funnels through :func:`mxtpu.ndarray.ndarray.apply_op`, which
+handles autograd taping. On TPU, XLA fuses chains of these into single
+kernels once inside ``hybridize()``/``jax.jit``.
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+from builtins import slice as builtins_slice
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..base import dtype_np
+from .ndarray import (NDArray, apply_op, array, zeros, ones, arange)
+from .ndarray import concat as _nd_concat, stack as _nd_stack, full as _nd_full
+
+__all__ = ["OP_REGISTRY", "register_op"]
+
+OP_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_op(name: str, aliases=()):
+    """Register an op. The wrapped python fn takes NDArrays + params and
+    returns NDArray(s); it must route math through apply_op."""
+    def deco(fn):
+        OP_REGISTRY[name] = fn
+        for a in aliases:
+            OP_REGISTRY[a] = fn
+        globals()[name] = fn
+        if name not in __all__:
+            __all__.append(name)
+        for a in aliases:
+            globals()[a] = fn
+            if a not in __all__:
+                __all__.append(a)
+        return fn
+    return deco
+
+
+def _unary(name, raw, aliases=()):
+    @register_op(name, aliases)
+    @functools.wraps(raw)
+    def op(data, **kwargs):
+        return apply_op(raw, [data], name)
+    op.__name__ = name
+    return op
+
+
+def _binary_broadcast(name, raw, aliases=()):
+    @register_op(name, aliases)
+    def op(lhs, rhs, **kwargs):
+        if isinstance(rhs, NDArray):
+            return apply_op(raw, [lhs, rhs], name)
+        return apply_op(lambda x: raw(x, rhs), [lhs], name)
+    op.__name__ = name
+    return op
+
+
+# -- elementwise unary (reference src/operator/tensor/elemwise_unary_op*) ----
+_unary("negative", jnp.negative)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lax.rsqrt)
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("reciprocal", jnp.reciprocal)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("zeros_like", jnp.zeros_like)
+_unary("ones_like", jnp.ones_like)
+_unary("identity", lambda x: x, aliases=("copy",))
+_unary("stop_gradient", lax.stop_gradient, aliases=("BlockGrad",))
+_unary("make_loss", lambda x: x, aliases=("MakeLoss",))
+_unary("isnan", lambda x: jnp.isnan(x).astype(jnp.float32))
+_unary("isinf", lambda x: jnp.isinf(x).astype(jnp.float32))
+_unary("isfinite", lambda x: jnp.isfinite(x).astype(jnp.float32))
+
+
+# -- elementwise binary with numpy broadcasting (broadcast_* family) ---------
+_binary_broadcast("broadcast_add", jnp.add, aliases=("elemwise_add", "add"))
+_binary_broadcast("broadcast_sub", jnp.subtract,
+                  aliases=("elemwise_sub", "subtract", "broadcast_minus"))
+_binary_broadcast("broadcast_mul", jnp.multiply,
+                  aliases=("elemwise_mul", "multiply"))
+_binary_broadcast("broadcast_div", jnp.divide, aliases=("elemwise_div", "divide"))
+_binary_broadcast("broadcast_mod", jnp.mod, aliases=("modulo",))
+_binary_broadcast("broadcast_power", jnp.power, aliases=("power",))
+_binary_broadcast("broadcast_maximum", jnp.maximum, aliases=("maximum",))
+_binary_broadcast("broadcast_minimum", jnp.minimum, aliases=("minimum",))
+_binary_broadcast("broadcast_hypot", jnp.hypot, aliases=("hypot",))
+_binary_broadcast("arctan2", jnp.arctan2)
+
+for _nm, _raw in [("equal", jnp.equal), ("not_equal", jnp.not_equal),
+                  ("greater", jnp.greater), ("greater_equal", jnp.greater_equal),
+                  ("lesser", jnp.less), ("lesser_equal", jnp.less_equal),
+                  ("logical_and", jnp.logical_and), ("logical_or", jnp.logical_or),
+                  ("logical_xor", jnp.logical_xor)]:
+    _binary_broadcast("broadcast_" + _nm,
+                      (lambda r: lambda a, b: r(a, b).astype(
+                          a.dtype if a.dtype != jnp.bool_ else jnp.float32))(_raw),
+                      aliases=(_nm,))
+
+
+# -- reductions (src/operator/tensor/broadcast_reduce_op_value*) -------------
+def _reduce_op(name, raw, aliases=()):
+    @register_op(name, aliases)
+    def op(data, axis=None, keepdims=False, exclude=False, **kwargs):
+        if exclude and axis is not None:
+            ax = axis if isinstance(axis, (list, tuple)) else (axis,)
+            axis = tuple(i for i in range(data.ndim) if i not in
+                         tuple(a % data.ndim for a in ax))
+        if isinstance(axis, list):
+            axis = tuple(axis)
+        return apply_op(lambda x: raw(x, axis=axis, keepdims=keepdims),
+                        [data], name)
+    op.__name__ = name
+    return op
+
+
+_reduce_op("sum", jnp.sum, aliases=("sum_axis",))
+_reduce_op("mean", jnp.mean)
+_reduce_op("prod", jnp.prod)
+_reduce_op("nansum", jnp.nansum)
+_reduce_op("nanprod", jnp.nanprod)
+_reduce_op("max", jnp.max, aliases=("max_axis",))
+_reduce_op("min", jnp.min, aliases=("min_axis",))
+
+
+@register_op("norm")
+def norm(data, ord=2, axis=None, keepdims=False, **kwargs):
+    def _f(x):
+        if axis is None:
+            return jnp.linalg.norm(x.reshape(-1), ord=ord, keepdims=keepdims)
+        return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+    return apply_op(_f, [data], "norm")
+
+
+@register_op("argmax")
+def argmax(data, axis=None, keepdims=False, **kwargs):
+    return apply_op(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims)
+                    .astype(jnp.float32), [data], "argmax")
+
+
+@register_op("argmin")
+def argmin(data, axis=None, keepdims=False, **kwargs):
+    return apply_op(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims)
+                    .astype(jnp.float32), [data], "argmin")
+
+
+@register_op("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, **kwargs):
+    def _f(x):
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+        if is_ascend:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "both":
+            return (vals, idx.astype(jnp.float32))
+        return idx.astype(jnp.float32)
+    n_out = 2 if ret_typ == "both" else 1
+    return apply_op(_f, [data], "topk", n_out=n_out)
+
+
+@register_op("sort")
+def sort(data, axis=-1, is_ascend=True, **kwargs):
+    def _f(x):
+        s = jnp.sort(x, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+    return apply_op(_f, [data], "sort")
+
+
+@register_op("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32", **kwargs):
+    def _f(x):
+        s = jnp.argsort(x, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(dtype_np(dtype))
+    return apply_op(_f, [data], "argsort")
+
+
+# -- shape ops (src/operator/tensor/matrix_op*) ------------------------------
+@register_op("reshape", aliases=("Reshape",))
+def reshape(data, shape, reverse=False, **kwargs):
+    return data.reshape(shape)
+
+
+@register_op("transpose")
+def transpose(data, axes=None, **kwargs):
+    return data.transpose(axes if axes else None)
+
+
+@register_op("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0, **kwargs):
+    return data.swapaxes(dim1, dim2)
+
+
+@register_op("expand_dims")
+def expand_dims(data, axis, **kwargs):
+    return data.expand_dims(axis)
+
+
+@register_op("squeeze")
+def squeeze(data, axis=None, **kwargs):
+    return data.squeeze(axis)
+
+
+@register_op("flatten", aliases=("Flatten",))
+def flatten(data, **kwargs):
+    return data.flatten()
+
+
+@register_op("broadcast_to")
+def broadcast_to(data, shape, **kwargs):
+    shape = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return data.broadcast_to(shape)
+
+
+@register_op("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis, size, **kwargs):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return data.broadcast_to(tuple(shape))
+
+
+@register_op("slice")
+def slice(data, begin, end, step=None, **kwargs):  # noqa: A001
+    idx = tuple(builtins_slice(b, e, s) for b, e, s in
+                zip(begin, end, step or [None] * len(begin)))
+    return apply_op(lambda x: x[idx], [data], "slice")
+
+
+@register_op("slice_axis")
+def slice_axis(data, axis, begin, end, **kwargs):
+    if end is None:
+        end = data.shape[axis]
+    return data.slice_axis(axis, begin, end)
+
+
+@register_op("slice_like")
+def slice_like(data, shape_like, axes=None, **kwargs):
+    tgt = shape_like.shape
+    idx = [builtins_slice(None)] * data.ndim
+    axes = axes if axes else range(builtins.min(data.ndim, len(tgt)))
+    for a in axes:
+        idx[a] = builtins_slice(0, tgt[a])
+    idx = tuple(idx)
+    return apply_op(lambda x: x[idx], [data], "slice_like")
+
+
+@register_op("concat", aliases=("Concat",))
+def concat_op(*data, dim=1, **kwargs):
+    return _nd_concat(*data, dim=dim)
+
+
+@register_op("stack")
+def stack_op(*data, axis=0, **kwargs):
+    return _nd_stack(*data, axis=axis)
+
+
+@register_op("split", aliases=("SliceChannel",))
+def split(data, num_outputs, axis=1, squeeze_axis=False, **kwargs):
+    def _f(x):
+        parts = jnp.split(x, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    out = apply_op(_f, [data], "split", n_out=num_outputs)
+    return out if num_outputs > 1 else (out,)
+
+
+@register_op("tile")
+def tile(data, reps, **kwargs):
+    return data.tile(reps)
+
+
+@register_op("repeat")
+def repeat(data, repeats, axis=None, **kwargs):
+    return data.repeat(repeats, axis)
+
+
+@register_op("flip", aliases=("reverse",))
+def flip(data, axis, **kwargs):
+    return apply_op(lambda x: jnp.flip(x, axis=axis), [data], "flip")
+
+
+@register_op("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=None, constant_value=0, **kwargs):
+    # MXNet pad_width is a flat tuple (before0, after0, before1, after1, ...)
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1])
+          for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    def _f(x):
+        if jmode == "constant":
+            return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+        return jnp.pad(x, pw, mode=jmode)
+    return apply_op(_f, [data], "pad")
+
+
+@register_op("clip")
+def clip(data, a_min=None, a_max=None, **kwargs):
+    return data.clip(a_min, a_max)
+
+
+@register_op("cast", aliases=("Cast", "amp_cast"))
+def cast(data, dtype, **kwargs):
+    return data.astype(dtype)
+
+
+@register_op("shape_array")
+def shape_array(data, **kwargs):
+    return array(list(data.shape), dtype="int64")
+
+
+@register_op("size_array")
+def size_array(data, **kwargs):
+    return array([data.size], dtype="int64")
+
+
+@register_op("diag")
+def diag(data, k=0, **kwargs):
+    return apply_op(lambda x: jnp.diag(x, k) if x.ndim <= 2
+                    else jnp.diagonal(x, k, -2, -1), [data], "diag")
+
+
+@register_op("where")
+def where(condition, x, y, **kwargs):
+    return apply_op(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                    [condition, x, y], "where")
+
+
+# -- linalg (src/operator/tensor/dot-inl.h, la_op*) --------------------------
+@register_op("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    def _f(a, b):
+        if transpose_a:
+            a = a.T if a.ndim <= 2 else jnp.moveaxis(a, 0, -1)
+        if transpose_b:
+            b = b.T if b.ndim <= 2 else jnp.moveaxis(b, -1, 0)
+        if a.ndim == 1 and b.ndim == 1:
+            return jnp.dot(a, b)
+        # MXNet dot: contract last axis of a with first axis of b
+        return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+    return apply_op(_f, [lhs, rhs], "dot")
+
+
+@register_op("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    def _f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return apply_op(_f, [lhs, rhs], "batch_dot")
+
+
+@register_op("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kwargs):
+    def _f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+    return apply_op(_f, [A, B], "linalg_gemm2")
+
+
+@register_op("linalg_potrf")
+def linalg_potrf(A, **kwargs):
+    return apply_op(jnp.linalg.cholesky, [A], "linalg_potrf")
+
+
+@register_op("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0, **kwargs):
+    def _f(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+    return apply_op(_f, [A], "linalg_syrk")
+
+
+@register_op("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **kwargs):
+    def _f(a, b):
+        import jax.scipy.linalg as jsl
+        a2 = jnp.swapaxes(a, -1, -2) if transpose else a
+        low = lower != transpose
+        if rightside:
+            x = jsl.solve_triangular(jnp.swapaxes(a2, -1, -2),
+                                     jnp.swapaxes(b, -1, -2), lower=not low)
+            return alpha * jnp.swapaxes(x, -1, -2)
+        return alpha * jsl.solve_triangular(a2, b, lower=low)
+    return apply_op(_f, [A, B], "linalg_trsm")
+
+
+# -- indexing (src/operator/tensor/indexing_op*) -----------------------------
+@register_op("take")
+def take(a, indices, axis=0, mode="clip", **kwargs):
+    def _f(x, idx):
+        return jnp.take(x, idx.astype(jnp.int32), axis=axis,
+                        mode="clip" if mode == "clip" else "wrap")
+    return apply_op(_f, [a, indices], "take")
+
+
+@register_op("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **kwargs):
+    def _f(x, idx):
+        out = jnp.take_along_axis(
+            x, jnp.expand_dims(idx.astype(jnp.int32), axis), axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis)
+    return apply_op(_f, [data, index], "pick")
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices, **kwargs):
+    def _f(x, idx):
+        idx = idx.astype(jnp.int32)
+        return x[tuple(idx[i] for i in range(idx.shape[0]))]
+    return apply_op(_f, [data, indices], "gather_nd")
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, shape, **kwargs):
+    def _f(d, idx):
+        idx = idx.astype(jnp.int32)
+        out = jnp.zeros(tuple(shape), d.dtype)
+        return out.at[tuple(idx[i] for i in range(idx.shape[0]))].add(d)
+    return apply_op(_f, [data, indices], "scatter_nd")
+
+
+@register_op("one_hot")
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32", **kwargs):
+    def _f(x):
+        oh = jax.nn.one_hot(x.astype(jnp.int32), depth, dtype=dtype_np(dtype))
+        return oh * (on_value - off_value) + off_value
+    return apply_op(_f, [indices], "one_hot")
+
+
+@register_op("Embedding", aliases=("embedding",))
+def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False, **kwargs):
+    """Embedding lookup (reference src/operator/tensor/indexing_op.cc)."""
+    return apply_op(lambda idx, w: jnp.take(w, idx.astype(jnp.int32), axis=0),
+                    [data, weight], "Embedding")
+
+
+@register_op("sequence_mask", aliases=("SequenceMask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kwargs):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    def _f(x, slen):
+        T = x.shape[axis]
+        pos = jnp.arange(T)
+        shape = [1] * x.ndim
+        shape[axis] = T
+        pos = pos.reshape(shape)
+        sl = slen
+        bshape = [1] * x.ndim
+        bshape[1 - axis] = x.shape[1 - axis]
+        sl = sl.reshape(bshape)
+        return jnp.where(pos < sl, x, jnp.asarray(value, x.dtype))
+    return apply_op(_f, [data, sequence_length], "sequence_mask")
+
+
+@register_op("sequence_last", aliases=("SequenceLast",))
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0, **kwargs):
+    if not use_sequence_length or sequence_length is None:
+        return apply_op(lambda x: jnp.take(x, x.shape[axis] - 1, axis=axis),
+                        [data], "sequence_last")
+    def _f(x, slen):
+        idx = (slen - 1).astype(jnp.int32)
+        xm = jnp.moveaxis(x, axis, 0)
+        return xm[idx, jnp.arange(xm.shape[1])]
+    return apply_op(_f, [data, sequence_length], "sequence_last")
+
+
+@register_op("sequence_reverse", aliases=("SequenceReverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0, **kwargs):
+    if not use_sequence_length or sequence_length is None:
+        return apply_op(lambda x: jnp.flip(x, axis=axis), [data], "sequence_reverse")
+    def _f(x, slen):
+        T = x.shape[axis]
+        xm = jnp.moveaxis(x, axis, 0)          # (T, B, ...)
+        pos = jnp.arange(T)[:, None]
+        sl = slen.astype(jnp.int32)[None, :]
+        rev = jnp.where(pos < sl, sl - 1 - pos, pos)
+        out = jnp.take_along_axis(
+            xm, rev.reshape(rev.shape + (1,) * (xm.ndim - 2)).astype(jnp.int32),
+            axis=0)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(_f, [data, sequence_length], "sequence_reverse")
+
+
+# -- neural-net ops (reference src/operator/nn/) -----------------------------
+@register_op("FullyConnected", aliases=("fully_connected",))
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True, **kwargs):
+    """y = x·Wᵀ + b (reference src/operator/nn/fully_connected.cc)."""
+    arrs = [data, weight] + ([] if no_bias or bias is None else [bias])
+    def _f(x, w, *b):
+        if flatten:
+            x = x.reshape(x.shape[0], -1)
+        y = jnp.matmul(x, w.T)
+        if b:
+            y = y + b[0]
+        return y
+    return apply_op(_f, arrs, "FullyConnected")
+
+
+@register_op("Activation", aliases=("activation",))
+def Activation(data, act_type="relu", **kwargs):
+    raw = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+           "softsign": jax.nn.soft_sign}[act_type]
+    return apply_op(raw, [data], f"Activation[{act_type}]")
+
+
+@register_op("LeakyReLU")
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334, **kwargs):
+    if act_type in ("leaky", "rrelu"):
+        return apply_op(lambda x: jax.nn.leaky_relu(x, slope), [data], "LeakyReLU")
+    if act_type == "elu":
+        return apply_op(lambda x: jax.nn.elu(x, slope), [data], "elu")
+    if act_type == "selu":
+        return apply_op(jax.nn.selu, [data], "selu")
+    if act_type == "gelu":
+        return apply_op(lambda x: jax.nn.gelu(x, approximate=False), [data], "gelu")
+    if act_type == "prelu":
+        return apply_op(lambda x, g: jnp.where(x >= 0, x, g * x),
+                        [data, gamma], "prelu")
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("softmax")
+def softmax(data, axis=-1, temperature=None, length=None, **kwargs):
+    def _f(x):
+        z = x / temperature if temperature else x
+        return jax.nn.softmax(z, axis=axis)
+    return apply_op(_f, [data], "softmax")
+
+
+@register_op("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, **kwargs):
+    def _f(x):
+        z = x / temperature if temperature else x
+        return jax.nn.log_softmax(z, axis=axis)
+    return apply_op(_f, [data], "log_softmax")
+
+
+@register_op("softmin")
+def softmin(data, axis=-1, **kwargs):
+    return apply_op(lambda x: jax.nn.softmax(-x, axis=axis), [data], "softmin")
+
+
+@register_op("SoftmaxOutput", aliases=("softmax_output",))
+def SoftmaxOutput(data, label=None, grad_scale=1.0, ignore_label=-1,
+                  use_ignore=False, multi_output=False, **kwargs):
+    """Legacy combined softmax + cross-entropy-gradient op (reference
+    src/operator/softmax_output.cc): forward is softmax; backward IGNORES
+    the incoming head gradient and injects (softmax - one_hot(label)) *
+    grad_scale, exactly like the reference's hard-coded backward."""
+    if label is None:
+        return softmax(data, axis=-1)
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def _so(x, l):
+        return jax.nn.softmax(x, axis=axis)
+
+    def _so_fwd(x, l):
+        out = jax.nn.softmax(x, axis=axis)
+        return out, (out, l)
+
+    def _so_bwd(res, g):
+        out, l = res
+        depth = out.shape[axis]
+        oh = jax.nn.one_hot(l.astype(jnp.int32), depth, dtype=out.dtype,
+                            axis=axis)
+        gx = (out - oh) * grad_scale
+        if use_ignore:
+            mask = (l != ignore_label).astype(out.dtype)
+            mask = jnp.expand_dims(mask, axis)
+            gx = gx * mask
+        return gx, jnp.zeros_like(l)
+
+    _so.defvjp(_so_fwd, _so_bwd)
+    return apply_op(_so, [data, label], "SoftmaxOutput")
+
+
+@register_op("Convolution", aliases=("convolution",))
+def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **kwargs):
+    """N-D convolution, NCHW layout like the reference
+    (src/operator/nn/convolution.cc). Lowers to lax.conv_general_dilated →
+    MXU. bf16-friendly."""
+    nd = len(kernel) if kernel else (data.ndim - 2)
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad_ = tuple(pad) if pad else (0,) * nd
+    arrs = [data, weight] + ([] if no_bias or bias is None else [bias])
+
+    spec = {1: ("NCH", "OIH", "NCH"),
+            2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+
+    def _f(x, w, *b):
+        y = lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(p, p) for p in pad_],
+            rhs_dilation=dilate, dimension_numbers=spec,
+            feature_group_count=num_group,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        y = y.astype(x.dtype)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nd)
+        return y
+    return apply_op(_f, arrs, "Convolution")
+
+
+@register_op("Deconvolution", aliases=("deconvolution",))
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=True, **kwargs):
+    """Transposed convolution (reference src/operator/nn/deconvolution.cc)."""
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    pad_ = tuple(pad) if pad else (0,) * nd
+    adj = tuple(adj) if adj else (0,) * nd
+    arrs = [data, weight] + ([] if no_bias or bias is None else [bias])
+    spec = {1: ("NCH", "IOH", "NCH"), 2: ("NCHW", "IOHW", "NCHW"),
+            3: ("NCDHW", "IODHW", "NCDHW")}[nd]
+
+    def _f(x, w, *b):
+        pads = [(k - 1 - p, k - 1 - p + a)
+                for k, p, a in zip(kernel, pad_, adj)]
+        y = lax.conv_general_dilated(
+            x, jnp.flip(w, axis=tuple(range(2, 2 + nd))),
+            window_strides=(1,) * nd, padding=pads,
+            lhs_dilation=stride, dimension_numbers=spec,
+            feature_group_count=num_group)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nd)
+        return y
+    return apply_op(_f, arrs, "Deconvolution")
+
+
+@register_op("Pooling", aliases=("pooling",))
+def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True,
+            **kwargs):
+    """Pooling (reference src/operator/nn/pooling.cc), NC+spatial layout."""
+    nd = data.ndim - 2
+    if global_pool:
+        ax = tuple(range(2, 2 + nd))
+        raw = {"max": lambda x: jnp.max(x, axis=ax, keepdims=True),
+               "avg": lambda x: jnp.mean(x, axis=ax, keepdims=True),
+               "sum": lambda x: jnp.sum(x, axis=ax, keepdims=True)}[pool_type]
+        return apply_op(raw, [data], "GlobalPooling")
+    kernel = tuple(kernel)
+    stride = tuple(stride) if stride else kernel
+    pad_ = tuple(pad) if pad else (0,) * nd
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    lo_hi = [[p, p] for p in pad_]
+    if pooling_convention == "full":
+        # ceil-mode (reference 'full'): extend the high-side padding so the
+        # last partial window is kept
+        import math
+        for i, (k, s, p) in enumerate(zip(kernel, stride, pad_)):
+            in_dim = data.shape[2 + i]
+            out_dim = int(math.ceil((in_dim + 2 * p - k) / s)) + 1
+            need = (out_dim - 1) * s + k - in_dim - p
+            lo_hi[i][1] = builtins.max(need, p)  # `max` = reduce op here
+    pads = ((0, 0), (0, 0)) + tuple((lo, hi) for lo, hi in lo_hi)
+
+    def _f(x):
+        if pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                     dims, strides, pads)
+        s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+                              dims, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        cnt = lax.reduce_window(jnp.ones_like(x), jnp.asarray(0, x.dtype),
+                                lax.add, dims, strides, pads)
+        return s / cnt
+    return apply_op(_f, [data], f"Pooling[{pool_type}]")
+
+
+@register_op("BatchNorm", aliases=("batch_norm",))
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=False,
+              output_mean_var=False, axis=1, **kwargs):
+    """BatchNorm forward (reference src/operator/nn/batch_norm.cc).
+
+    Note: imperative/eager path only — running-stat update is handled by
+    gluon.nn.BatchNorm which owns the state; this op uses batch stats in
+    train mode (autograd.is_training) and moving stats otherwise.
+    """
+    use_batch_stats = autograd.is_training() and not use_global_stats
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+
+    def _f(x, g, b, mm, mv):
+        if fix_gamma:
+            g = jnp.ones_like(g)
+        if use_batch_stats:
+            mean = jnp.mean(x.astype(jnp.float32), axis=red)
+            var = jnp.var(x.astype(jnp.float32), axis=red)
+        else:
+            mean, var = mm, mv
+        inv = lax.rsqrt(var + eps) * g
+        out = (x - mean.reshape(bshape).astype(x.dtype)) * \
+            inv.reshape(bshape).astype(x.dtype) + b.reshape(bshape).astype(x.dtype)
+        return out
+    return apply_op(_f, [data, gamma, beta, moving_mean, moving_var], "BatchNorm")
+
+
+@register_op("LayerNorm", aliases=("layer_norm",))
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False,
+              **kwargs):
+    def _f(x, g, b):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axis, keepdims=True)
+        var = jnp.var(xf, axis=axis, keepdims=True)
+        out = (xf - mean) * lax.rsqrt(var + eps)
+        bshape = [1] * x.ndim
+        bshape[axis] = x.shape[axis]
+        return (out * g.reshape(bshape) + b.reshape(bshape)).astype(x.dtype)
+    return apply_op(_f, [data, gamma, beta], "LayerNorm")
+
+
+@register_op("InstanceNorm")
+def InstanceNorm(data, gamma, beta, eps=1e-3, **kwargs):
+    def _f(x, g, b):
+        red = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=red, keepdims=True)
+        var = jnp.var(x, axis=red, keepdims=True)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        return (x - mean) * lax.rsqrt(var + eps) * g.reshape(bshape) + b.reshape(bshape)
+    return apply_op(_f, [data, gamma, beta], "InstanceNorm")
+
+
+@register_op("L2Normalization")
+def L2Normalization(data, eps=1e-10, mode="instance", **kwargs):
+    def _f(x):
+        if mode == "instance":
+            n = jnp.sqrt(jnp.sum(jnp.square(x.reshape(x.shape[0], -1)),
+                                 axis=1) + eps)
+            return x / n.reshape((-1,) + (1,) * (x.ndim - 1))
+        if mode == "channel":
+            n = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+            return x / n
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(range(2, x.ndim)),
+                             keepdims=True) + eps)
+        return x / n
+    return apply_op(_f, [data], "L2Normalization")
+
+
+@register_op("Dropout", aliases=("dropout",))
+def Dropout(data, p=0.5, mode="training", axes=None, **kwargs):
+    """Dropout (reference src/operator/nn/dropout.cc). Active only under
+    autograd.train_mode, like the reference's dependence on ctx.is_train."""
+    if not autograd.is_training() or p <= 0:
+        return apply_op(lambda x: x, [data], "Dropout")
+    from . import random as _rnd
+    key = _rnd._next_key()
+
+    def _f(x):
+        shape = x.shape
+        if axes:
+            shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return apply_op(_f, [data], "Dropout")
+
+
+@register_op("where_v2", aliases=())
+def where_v2(condition, x, y, **kwargs):
+    return where(condition, x, y)
+
+
+# -- losses as ops ----------------------------------------------------------
+@register_op("smooth_l1")
+def smooth_l1(data, scalar=1.0, **kwargs):
+    def _f(x):
+        s2 = scalar * scalar
+        ax = jnp.abs(x)
+        return jnp.where(ax < 1.0 / s2, 0.5 * s2 * jnp.square(x), ax - 0.5 / s2)
+    return apply_op(_f, [data], "smooth_l1")
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label, **kwargs):
+    def _f(x, l):
+        lp = jax.nn.log_softmax(x, axis=-1)
+        oh = jax.nn.one_hot(l.astype(jnp.int32), x.shape[-1], dtype=lp.dtype)
+        return -jnp.sum(oh * lp)
+    return apply_op(_f, [data, label], "softmax_cross_entropy")
+
+
+# -- misc -------------------------------------------------------------------
+@register_op("add_n", aliases=("ElementWiseSum",))
+def add_n(*args, **kwargs):
+    return apply_op(lambda *xs: functools.reduce(jnp.add, xs),
+                    list(args), "add_n")
+
+
+@register_op("cumsum")
+def cumsum(a, axis=None, dtype=None, **kwargs):
+    def _f(x):
+        out = jnp.cumsum(x.reshape(-1) if axis is None else x, axis=axis or 0)
+        return out.astype(dtype_np(dtype)) if dtype else out
+    return apply_op(_f, [a], "cumsum")
+
+
+@register_op("full")
+def full_op(shape, val, ctx=None, dtype=None, **kwargs):
+    return _nd_full(shape, val, ctx, dtype)

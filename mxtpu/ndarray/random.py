@@ -1,0 +1,162 @@
+"""Random sampling ops with MXNet's stateful-seed API over jax PRNG.
+
+Rebuild of the reference random ops (``src/operator/random/sample_op*``,
+``src/common/random_generator.*`` [path cite]): a process-global counter
+PRNG (`mx.random.seed(n)`) that internally splits a jax PRNG key per call
+— same user model as the reference's per-device Philox streams, but the
+actual bits come from jax's threefry, so sampling inside jit/hybridize
+stays functional.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import dtype_np
+from ..context import Context
+from .ndarray import NDArray
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
+           "exponential", "poisson", "multinomial", "bernoulli", "shuffle",
+           "current_key"]
+
+_state = threading.local()
+
+
+def _key_state():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(int(time.time_ns()) % (2 ** 31))
+    return _state
+
+
+def seed(seed_state: int, ctx: str = "all") -> None:
+    """Seed the global generator (reference ``mx.random.seed``)."""
+    _key_state().key = jax.random.PRNGKey(int(seed_state))
+
+
+def _next_key():
+    st = _key_state()
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def current_key():
+    """Expose the underlying PRNG key (TPU-native extension) so jitted
+    training steps can thread keys functionally."""
+    return _key_state().key
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _place(x, ctx: Optional[Context]):
+    if ctx is not None:
+        x = jax.device_put(x, ctx.jax_device())
+    return NDArray(x)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    dt = dtype_np(dtype or "float32")
+    val = jax.random.uniform(_next_key(), _shape(shape), jnp.float32,
+                             low, high).astype(dt)
+    if out is not None:
+        out._set_data(val)
+        return out
+    return _place(val, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    dt = dtype_np(dtype or "float32")
+    val = (jax.random.normal(_next_key(), _shape(shape), jnp.float32)
+           * scale + loc).astype(dt)
+    if out is not None:
+        out._set_data(val)
+        return out
+    return _place(val, ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, **kw):
+    return normal(loc, scale, shape, dtype, ctx)
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None, **kw):
+    if high is None:
+        low, high = 0, low
+    val = jax.random.randint(_next_key(), _shape(shape), low, high,
+                             dtype_np(dtype))
+    return _place(val, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, **kw):
+    dt = dtype_np(dtype or "float32")
+    a = alpha._data if isinstance(alpha, NDArray) else alpha
+    b = beta._data if isinstance(beta, NDArray) else beta
+    val = (jax.random.gamma(_next_key(), a, _shape(shape) or jnp.shape(a))
+           * b).astype(dt)
+    return _place(val, ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, **kw):
+    dt = dtype_np(dtype or "float32")
+    val = (jax.random.exponential(_next_key(), _shape(shape)) * scale).astype(dt)
+    return _place(val, ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, **kw):
+    dt = dtype_np(dtype or "float32")
+    val = jax.random.poisson(_next_key(), lam, _shape(shape)).astype(dt)
+    return _place(val, ctx)
+
+
+def bernoulli(p=0.5, shape=None, dtype=None, ctx=None, **kw):
+    dt = dtype_np(dtype or "float32")
+    val = jax.random.bernoulli(_next_key(), p, _shape(shape)).astype(dt)
+    return _place(val, ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    """Sample from categorical distribution(s); returns MXNet's
+    (batch..., n) layout for batched inputs."""
+    probs = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    logits = jnp.log(jnp.clip(probs, 1e-30, None))
+    n = _shape(shape)
+    batch = probs.shape[:-1]
+    if not batch:
+        samp = jax.random.categorical(_next_key(), logits, shape=n or ())
+    else:
+        # jax.random.categorical puts batch dims trailing; transpose to
+        # MXNet's (batch..., n)
+        samp = jax.random.categorical(_next_key(), logits, axis=-1,
+                                      shape=n + batch if n else None)
+        if n:
+            perm = (tuple(range(len(n), len(n) + len(batch)))
+                    + tuple(range(len(n))))
+            samp = jnp.transpose(samp, perm)
+    samp_i = samp.astype(jnp.int32)
+    if get_prob:
+        logp = jnp.log(jnp.clip(probs, 1e-30, None))
+        if not batch:
+            lp = logp[samp_i]
+        else:
+            tgt = samp_i.shape + (probs.shape[-1],)
+            src = logp.reshape(batch + (1,) * (samp_i.ndim - len(batch))
+                               + (probs.shape[-1],))
+            lp = jnp.take_along_axis(jnp.broadcast_to(src, tgt),
+                                     samp_i[..., None], axis=-1)[..., 0]
+        return NDArray(samp.astype(dtype_np(dtype))), NDArray(lp)
+    return NDArray(samp.astype(dtype_np(dtype)))
+
+
+def shuffle(data, **kw):
+    x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    return NDArray(jax.random.permutation(_next_key(), x, axis=0))

@@ -1,0 +1,134 @@
+"""Binary (de)serialization of NDArray containers — the ``.params`` format.
+
+Rebuild of the reference's NDArray save/load (``src/ndarray/ndarray.cc``
+NDArray::Save/Load + ``MXNDArraySave`` container in ``src/c_api/c_api.cc``
+[path cite]), byte-compatible with the MXNet 1.x dense layout so model-zoo
+weight files interchange:
+
+    uint64 kMXAPINDArrayListMagic (0x112), uint64 reserved
+    vector<NDArray>:  uint64 count, then per array:
+        uint32 NDARRAY_V2_MAGIC (0xF993FAC9)
+        int32  storage_type (-1 == dense/kDefaultStorage marker used here)
+        TShape: uint32 ndim, uint32 dims[ndim]
+        Context: int32 dev_type (1=cpu), int32 dev_id
+        int32  type_flag (mshadow enum)
+        raw data bytes
+    vector<string> names: uint64 count, (uint64 len, bytes) each
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Union
+
+import numpy as _np
+
+from .base import MXNetError, dtype_np
+
+_LIST_MAGIC = 0x112
+_ND_MAGIC = 0xF993FAC9
+
+# mshadow type flags (3rdparty/mshadow/mshadow/base.h)
+_TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+              "int32": 4, "int8": 5, "int64": 6, "bool": 7, "bfloat16": 12}
+_FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
+
+
+def _np_of(arr) -> _np.ndarray:
+    from .ndarray.ndarray import NDArray
+    if isinstance(arr, NDArray):
+        return arr.asnumpy()
+    return _np.asarray(arr)
+
+
+def _write_ndarray(out: List[bytes], a: _np.ndarray) -> None:
+    out.append(struct.pack("<I", _ND_MAGIC))
+    out.append(struct.pack("<i", 0))  # kDefaultStorage (dense)
+    out.append(struct.pack("<I", a.ndim))
+    out.append(struct.pack(f"<{a.ndim}I", *a.shape) if a.ndim else b"")
+    out.append(struct.pack("<ii", 1, 0))  # cpu ctx
+    name = _np.dtype(a.dtype).name
+    if name not in _TYPE_FLAG:
+        a = a.astype(_np.float32)
+        name = "float32"
+    out.append(struct.pack("<i", _TYPE_FLAG[name]))
+    out.append(_np.ascontiguousarray(a).tobytes())
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf, self.pos = buf, 0
+
+    def read(self, fmt: str):
+        vals = struct.unpack_from("<" + fmt, self.buf, self.pos)
+        self.pos += struct.calcsize("<" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def read_bytes(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+
+def _read_ndarray(r: _Reader) -> _np.ndarray:
+    magic = r.read("I")
+    if magic != _ND_MAGIC:
+        raise MXNetError(f"bad NDArray magic {magic:#x} (legacy v0/v1 "
+                         "formats not supported)")
+    stype = r.read("i")
+    # 0 == kDefaultStorage; accept -1 (kUndefinedStorage) written by early
+    # versions of this codec
+    if stype not in (0, -1):
+        raise MXNetError("sparse .params entries not supported yet")
+    ndim = r.read("I")
+    shape = tuple(r.read(f"{ndim}I")) if ndim > 1 else \
+        ((r.read("I"),) if ndim == 1 else ())
+    r.read("ii")  # ctx
+    flag = r.read("i")
+    dtype = dtype_np(_FLAG_TYPE[flag])
+    n = int(_np.prod(shape)) if shape else 1
+    data = _np.frombuffer(r.read_bytes(n * dtype.itemsize), dtype=dtype)
+    return data.reshape(shape).copy()
+
+
+def save_ndarrays(fname: str, data) -> None:
+    """``mx.nd.save``: data is NDArray, list[NDArray], or dict[str, NDArray]."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(data, NDArray):
+        arrays, names = [data], []
+    elif isinstance(data, (list, tuple)):
+        arrays, names = list(data), []
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+    out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
+                        struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _write_ndarray(out, _np_of(a))
+    out.append(struct.pack("<Q", len(names)))
+    for nm in names:
+        b = nm.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+def load_ndarrays(fname: str):
+    from .ndarray.ndarray import array as nd_array
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    magic, _ = r.read("QQ")
+    if magic != _LIST_MAGIC:
+        raise MXNetError(f"invalid .params file (magic {magic:#x})")
+    n = r.read("Q")
+    arrays = [nd_array(_read_ndarray(r)) for _ in range(n)]
+    n_names = r.read("Q")
+    if n_names == 0:
+        return arrays
+    names = []
+    for _ in range(n_names):
+        ln = r.read("Q")
+        names.append(r.read_bytes(ln).decode("utf-8"))
+    return dict(zip(names, arrays))

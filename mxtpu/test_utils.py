@@ -1,0 +1,189 @@
+"""Testing utilities — rebuild of ``python/mxnet/test_utils.py`` [path cite].
+
+Keeps the reference's four pillars (SURVEY.md §4.2): NumPy ground truth
+(`assert_almost_equal`), finite-difference gradient checking
+(`check_numeric_gradient` — validated against the tape/jax.vjp backward),
+cross-device consistency (`check_consistency` — TPU vs jax-CPU here, the
+analogue of cpu-vs-gpu), and the seeding fixture (`with_seed`, logs the
+seed on failure so flakes reproduce).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import random as _pyrandom
+from typing import Callable, List, Optional, Sequence
+
+import numpy as _np
+
+from . import context as _ctx
+from .base import env_int, env_str
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "random_arrays",
+           "check_numeric_gradient", "check_consistency", "with_seed",
+           "default_rtol_atol"]
+
+_default_ctx: Optional[_ctx.Context] = None
+
+
+def default_context() -> _ctx.Context:
+    """Honors MXNET_TEST_DEVICE like the reference's default_context()."""
+    global _default_ctx
+    if _default_ctx is not None:
+        return _default_ctx
+    dev = env_str("MXNET_TEST_DEVICE", "")
+    if dev:
+        return _ctx.Context(dev, 0)
+    return _ctx.current_context()
+
+
+def set_default_context(ctx: _ctx.Context) -> None:
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_rtol_atol(dtype) -> tuple:
+    dt = _np.dtype(dtype) if not isinstance(dtype, str) else dtype
+    name = dt if isinstance(dt, str) else dt.name
+    return {"float16": (1e-2, 1e-2), "bfloat16": (3e-2, 3e-2),
+            "float32": (1e-4, 1e-5), "float64": (1e-6, 1e-8)}.get(
+        name, (1e-4, 1e-5))
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b) -> bool:
+    return _np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None) -> bool:
+    a, b = _to_np(a), _to_np(b)
+    rtol = rtol if rtol is not None else 1e-4
+    atol = atol if atol is not None else 1e-5
+    return _np.allclose(a.astype(_np.float64), b.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=True)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")) -> None:
+    an, bn = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        drt, dat = default_rtol_atol(an.dtype)
+        rtol = rtol if rtol is not None else drt
+        atol = atol if atol is not None else dat
+    _np.testing.assert_allclose(
+        an.astype(_np.float64), bn.astype(_np.float64),
+        rtol=rtol, atol=atol, equal_nan=True,
+        err_msg=f"{names[0]} vs {names[1]}")
+
+
+def random_arrays(*shapes, dtype=_np.float32) -> List[_np.ndarray]:
+    out = [_np.random.randn(*s).astype(dtype) if s else
+           _np.asarray(_np.random.randn(), dtype) for s in shapes]
+    return out
+
+
+def rand_ndarray(shape, ctx=None, dtype="float32") -> NDArray:
+    return array(_np.random.randn(*shape), ctx=ctx, dtype=dtype)
+
+
+def check_numeric_gradient(f: Callable, inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3) -> None:
+    """Central-difference check of the tape backward of scalar-output ``f``.
+
+    Reference check_numeric_gradient perturbs each input element; here f
+    maps NDArrays → scalar NDArray loss.
+    """
+    from . import autograd
+    inputs = [x.astype("float64") if x.dtype.kind == "f" else x
+              for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        loss = f(*inputs)
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    def _eval(xi, host):
+        # host.copy(): jax may ingest numpy buffers zero-copy, and we mutate
+        # host in place between evals
+        args = [array(host.copy(), dtype="float64") if j == xi else inputs[j]
+                for j in range(len(inputs))]
+        return float(f(*args).asnumpy())
+
+    for xi, x in enumerate(inputs):
+        if x.dtype.kind != "f":
+            continue
+        # ascontiguousarray: jax can hand back F-contiguous buffers, and
+        # reshape(-1) on those copies — the perturbation below must be a view
+        host = _np.array(x.asnumpy(), dtype=_np.float64, order="C")
+        numeric = _np.zeros_like(host)
+        flat = host.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = _eval(xi, host)
+            flat[i] = orig - eps
+            fm = _eval(xi, host)
+            flat[i] = orig
+            num_flat[i] = (fp - fm) / (2 * eps)
+        _np.testing.assert_allclose(analytic[xi], numeric, rtol=rtol,
+                                    atol=atol,
+                                    err_msg=f"gradient of input {xi}")
+
+
+def check_consistency(f: Callable, inputs_np: Sequence[_np.ndarray],
+                      ctx_list: Optional[Sequence[_ctx.Context]] = None,
+                      rtol=None, atol=None) -> None:
+    """Run ``f`` on each context and cross-check outputs — the rebuild's
+    cpu-vs-tpu analogue of the reference's cpu-vs-gpu check_consistency."""
+    if ctx_list is None:
+        ctx_list = [_ctx.cpu(0)]
+        if _ctx.num_tpus() > 0:
+            ctx_list.append(_ctx.tpu(0))
+    results = []
+    for ctx in ctx_list:
+        ins = [array(x, ctx=ctx) for x in inputs_np]
+        out = f(*ins)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results.append([o.asnumpy() for o in outs])
+    ref = results[0]
+    for ctx, res in zip(ctx_list[1:], results[1:]):
+        for i, (r, o) in enumerate(zip(ref, res)):
+            rt, at = default_rtol_atol(r.dtype)
+            _np.testing.assert_allclose(
+                o.astype(_np.float64), r.astype(_np.float64),
+                rtol=rtol or rt * 10, atol=atol or at * 10,
+                err_msg=f"output {i} on {ctx} vs {ctx_list[0]}")
+
+
+def with_seed(seed: Optional[int] = None):
+    """Per-test seeding decorator that logs the seed on failure
+    (reference tests/python/unittest/common.py with_seed)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from .ndarray import random as mxrandom
+            env_seed = env_int("MXNET_TEST_SEED", -1)
+            this_seed = seed if seed is not None else (
+                env_seed if env_seed != -1 else
+                _np.random.randint(0, 2 ** 31))
+            _np.random.seed(this_seed)
+            _pyrandom.seed(this_seed)
+            mxrandom.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                logging.error("test failed with MXNET_TEST_SEED=%d "
+                              "(set it to reproduce)", this_seed)
+                raise
+        return wrapper
+    return deco

@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU mesh (SURVEY.md §4.2 —
+the rebuild's analogue of the reference's local-tracker distributed tests:
+sharding/collective tests run on virtual devices, no TPU pod needed).
+
+Must set env before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env says 'axon'
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402  (after env setup)
+
+jax.config.update("jax_enable_x64", True)
+# float32 tests compare against NumPy ground truth — use exact f32 matmuls
+jax.config.update("jax_default_matmul_precision", "highest")

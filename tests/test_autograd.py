@@ -1,0 +1,175 @@
+"""Autograd tape — rebuild of tests/python/unittest/test_autograd.py themes."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd as ag
+from mxtpu.test_utils import assert_almost_equal, check_numeric_gradient, with_seed
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_and_reuse():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x       # x^2
+        z = y * x       # x^3
+        w = z + y       # x^3 + x^2
+    w.backward()
+    # d/dx = 3x^2 + 2x = 16
+    assert_almost_equal(x.grad, np.array([16.0]))
+
+
+def test_grad_req_add():
+    x = mx.nd.array([3.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, np.array([6.0]))
+
+
+def test_grad_req_write_overwrites():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    for _ in range(3):
+        with ag.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, np.array([2.0]))
+
+
+def test_head_grads():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = 3 * x
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0]))
+
+
+def test_detach_blocks_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))  # only d(cx)/dx = c = x^2
+
+
+def test_stop_gradient_op():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.stop_gradient(x * x) * x
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+
+
+def test_pause():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        with ag.pause():
+            c = x * 10  # untracked
+        z = y + c
+    z.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+
+
+def test_is_training_modes():
+    assert not ag.is_training()
+    with ag.record():
+        assert ag.is_training()
+        with ag.predict_mode():
+            assert not ag.is_training()
+    with ag.record(train_mode=False):
+        assert not ag.is_training()
+        with ag.train_mode():
+            assert ag.is_training()
+
+
+def test_multi_output_op():
+    x = mx.nd.array([[3.0, 1.0, 2.0]])
+    x.attach_grad()
+    with ag.record():
+        vals, idx = mx.nd.topk(x, k=2, ret_typ="both")
+        loss = vals.sum()
+    loss.backward()
+    assert_almost_equal(x.grad, np.array([[1.0, 0.0, 1.0]]))
+
+
+def test_broadcast_grad():
+    x = mx.nd.ones((2, 3))
+    b = mx.nd.ones((3,))
+    x.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        y = (x + b).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.ones((2, 3)))
+    assert_almost_equal(b.grad, 2 * np.ones(3))
+
+
+@with_seed(42)
+def test_numeric_gradient_matmul():
+    a = mx.nd.random.normal(shape=(3, 4))
+    b = mx.nd.random.normal(shape=(4, 2))
+    check_numeric_gradient(lambda x, y: mx.nd.dot(x, y).sum(), [a, b])
+
+
+@with_seed(7)
+def test_numeric_gradient_composite():
+    x = mx.nd.random.uniform(0.5, 1.5, shape=(4,))
+    check_numeric_gradient(
+        lambda v: (mx.nd.log(v) * mx.nd.sqrt(v) + mx.nd.sigmoid(v)).sum(), [x])
+
+
+def test_autograd_grad_function():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+    gx = ag.grad(y, x)
+    assert_almost_equal(gx, np.array([12.0]))
+
+
+def test_custom_function():
+    class Square(ag.Function):
+        def forward(self, x):
+            self.saved = x
+            return x * x
+
+        def backward(self, dy):
+            return 2 * self.saved * dy
+
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    sq = Square()
+    with ag.record():
+        y = sq(x)
+    y.backward()
+    assert_almost_equal(x.grad, np.array([6.0]))
+
+
+def test_backward_through_setitem_error():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        try:
+            y[0] = 5.0
+            raised = False
+        except Exception:
+            raised = True
+    assert raised
