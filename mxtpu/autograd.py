@@ -101,9 +101,11 @@ class Node:
       None               — constant (no gradient flows)
     """
 
-    __slots__ = ("vjp_fn", "parents", "out_avals", "seq", "name")
+    __slots__ = ("vjp_fn", "parents", "out_avals", "seq", "name",
+                 "out_is_tuple")
 
-    def __init__(self, vjp_fn, parents, out_avals, name=""):
+    def __init__(self, vjp_fn, parents, out_avals, name="",
+                 out_is_tuple=False):
         st = _st()
         st.counter += 1
         self.seq = st.counter
@@ -111,22 +113,34 @@ class Node:
         self.parents = parents
         self.out_avals = out_avals  # list[(shape, dtype)] per output
         self.name = name
+        self.out_is_tuple = out_is_tuple  # primal returned a tuple
 
 
 def invoke(raw_fn: Callable, arrays: Sequence[Any], parents: Sequence[Any],
-           name: str = "") -> Tuple[Any, Optional[Node]]:
+           name: str = "", has_aux: bool = False) -> Tuple[Any, Optional[Node]]:
     """Run ``raw_fn(*arrays)`` (jax arrays in, jax array or tuple out).
 
     If recording and any parent is tracked, route through jax.vjp and
     return (outputs, Node); otherwise plain execution, Node=None.
+
+    With ``has_aux``, raw_fn returns ``(out, aux)`` and invoke returns
+    ``((out, aux), node)`` — aux carries non-differentiated state (the
+    CachedOp's batch-norm running stats etc., the analogue of the
+    reference's mutable aux states in FStatefulCompute).
     """
     tracked = is_recording() and any(p is not None for p in parents)
     if not tracked:
         return raw_fn(*arrays), None
-    out, vjp_fn = jax.vjp(raw_fn, *arrays)
+    if has_aux:
+        out, vjp_fn, aux = jax.vjp(raw_fn, *arrays, has_aux=True)
+    else:
+        out, vjp_fn = jax.vjp(raw_fn, *arrays)
     outs = out if isinstance(out, tuple) else (out,)
     avals = [(o.shape, o.dtype) for o in outs]
-    node = Node(vjp_fn, list(parents), avals, name)
+    node = Node(vjp_fn, list(parents), avals, name,
+                out_is_tuple=isinstance(out, tuple))
+    if has_aux:
+        return (out, aux), node
     return out, node
 
 
@@ -199,7 +213,7 @@ def backward(heads: Sequence[Any], head_grads: Optional[Sequence[Any]] = None,
         cots = tuple(
             s if s is not None else jnp.zeros(shape, dtype)
             for s, (shape, dtype) in zip(slots, node.out_avals))
-        cot = cots if len(node.out_avals) > 1 else cots[0]
+        cot = cots if node.out_is_tuple else cots[0]
         in_grads = node.vjp_fn(cot)
         for parent, g in zip(node.parents, in_grads):
             _route(parent, g)
@@ -291,7 +305,7 @@ class Function:
 
                 node = Node(_vjp, list(parents),
                             [(o.shape, o._data.dtype) for o in outs],
-                            type(self).__name__)
+                            type(self).__name__, out_is_tuple=not single)
                 for i, o in enumerate(outs):
                     o._ag = (node, i)
         return outputs

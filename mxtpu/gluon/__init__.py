@@ -1,0 +1,23 @@
+"""Gluon — the imperative/hybrid neural-network API (reference
+``python/mxnet/gluon/``)."""
+from .block import Block, HybridBlock
+from .parameter import (Constant, DeferredInitializationError, Parameter,
+                        ParameterDict)
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from .utils import split_and_load
+
+__all__ = ["Block", "HybridBlock", "Parameter", "ParameterDict", "Constant",
+           "DeferredInitializationError", "Trainer", "nn", "loss", "utils",
+           "split_and_load", "data", "rnn", "model_zoo"]
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("data", "rnn", "model_zoo", "contrib"):
+        mod = importlib.import_module(f"mxtpu.gluon.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxtpu.gluon' has no attribute {name!r}")

@@ -1,0 +1,432 @@
+"""Gluon Block / HybridBlock (reference ``python/mxnet/gluon/block.py``
+[path cite]).
+
+``hybridize()`` is the reference's trace→CachedOp pipeline
+(``src/imperative/cached_op.cc``) rebuilt on jax: the FIRST hybrid call
+runs eagerly (resolving deferred shapes, exactly like CachedOp's first-call
+shape passes); afterwards the whole net is ONE jitted function
+
+    raw(inputs..., params..., rng_key) -> ((outputs...), (aux_updates...))
+
+whose forward is a single XLA program and whose backward (via the autograd
+tape's ``jax.vjp`` over it) is another — MXNet's "one optimized unit, static
+memory planning" becomes XLA buffer assignment + fusion. Aux updates carry
+mutated non-differentiable state (BatchNorm running stats) out of the pure
+function, mirroring the reference's mutable aux_states.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from .. import autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray import random as _random
+from .parameter import (DeferredInitializationError, Parameter, ParameterDict)
+
+__all__ = ["Block", "HybridBlock"]
+
+
+# ---------------------------------------------------------------------------
+# naming
+# ---------------------------------------------------------------------------
+class _NameManager:
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+
+    @classmethod
+    def get(cls) -> "_NameManager":
+        if not hasattr(cls._current, "value"):
+            cls._current.value = _NameManager()
+        return cls._current.value
+
+    def next_prefix(self, hint: str) -> str:
+        count = self._counter.get(hint, 0)
+        self._counter[hint] = count + 1
+        return f"{hint}{count}_"
+
+
+class _BlockScope:
+    """Name scope: children created inside ``with block.name_scope():``
+    get prefixes nested under the block's prefix (reference behavior)."""
+
+    _current = threading.local()
+
+    def __init__(self, block: "Block"):
+        self._block = block
+        self._counter: Dict[str, int] = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix: Optional[str], params: Optional[ParameterDict],
+               hint: str) -> Tuple[str, ParameterDict]:
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _NameManager.get().next_prefix(hint)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+# ---------------------------------------------------------------------------
+# NDArray pytree helpers (NDArray is deliberately NOT a jax pytree — flatten
+# explicitly at the hybridize boundary)
+# ---------------------------------------------------------------------------
+def _flatten_nds(obj, out: List[NDArray]):
+    if isinstance(obj, NDArray):
+        out.append(obj)
+        return ("_",)
+    if isinstance(obj, (list, tuple)):
+        return tuple(_flatten_nds(x, out) for x in obj)
+    out.append(obj)  # non-array leaf passes through untouched
+    return ("_",)
+
+
+def _unflatten_nds(tree, flat: List[Any], pos: List[int]):
+    if tree == ("_",):
+        val = flat[pos[0]]
+        pos[0] += 1
+        return val
+    return tuple(_unflatten_nds(t, flat, pos) for t in tree)
+
+
+_TRACE_DEPTH = threading.local()
+
+
+def _in_trace() -> bool:
+    return getattr(_TRACE_DEPTH, "depth", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+class Block:
+    """Base class for all layers/models (imperative, reference
+    ``gluon.Block``)."""
+
+    def __init__(self, prefix: Optional[str] = None,
+                 params: Optional[ParameterDict] = None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+
+    def _alias(self) -> str:
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self) -> _BlockScope:
+        return self._scope
+
+    def __repr__(self):
+        s = f"{self.__class__.__name__}("
+        for k, v in self._children.items():
+            s += f"\n  ({k}): " + repr(v).replace("\n", "\n  ")
+        return s + ("\n)" if self._children else ")")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Block):
+            existing = self._children.get(name) \
+                if hasattr(self, "_children") else None
+            if existing is not None:
+                self._children[name] = value
+            else:
+                self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            if not hasattr(self, "_reg_params"):
+                raise RuntimeError(
+                    "call Block.__init__ before assigning Parameters")
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None) -> None:
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        """All parameters of this block and children, optionally filtered
+        by regex (reference semantics: ``select`` matches anywhere)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            ret._params.update(
+                {k: v for k, v in self._params.items() if pat.match(k)})
+        for p in self._reg_params.values():
+            if select is None or re.compile(select).match(p.name):
+                if p.name not in ret._params:
+                    ret._params[p.name] = p
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix: str = "") -> Dict[str, Parameter]:
+        """Attribute-path parameter names ('features.0.weight') used by
+        save_parameters/load_parameters (reference behavior — portable
+        across prefix differences)."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose: bool = False,
+                   force_reinit: bool = False) -> None:
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active: bool = True, **kwargs) -> None:
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype) -> None:
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self.collect_params().values():
+            p.cast(dtype)
+
+    def apply(self, fn: Callable[["Block"], None]) -> "Block":
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- serialization ------------------------------------------------------
+    def save_parameters(self, filename: str) -> None:
+        params = self._collect_params_with_prefix()
+        nd.save(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename: str, ctx=None,
+                        allow_missing: bool = False,
+                        ignore_extra: bool = False,
+                        cast_dtype: bool = False) -> None:
+        loaded = nd.load(filename)
+        # strip the arg:/aux: markers of the legacy save format
+        loaded = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
+                  for k, v in loaded.items()}
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            missing = [k for k in params if k not in loaded]
+            if missing:
+                raise RuntimeError(
+                    f"parameters {missing} missing in file {filename}")
+        if not ignore_extra:
+            extra = [k for k in loaded if k not in params]
+            if extra:
+                raise RuntimeError(
+                    f"file {filename} contains extra parameters {extra}")
+        for k, v in loaded.items():
+            if k in params:
+                if cast_dtype:
+                    v = v.astype(params[k].dtype)
+                params[k]._load_init(v, ctx)
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- execution ----------------------------------------------------------
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+class HybridBlock(Block):
+    """Block that can be compiled to one XLA program via ``hybridize()``."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op_params: Optional[List[Parameter]] = None
+        self._raw_cache: Dict[Any, Callable] = {}
+        self._aux_params_for: Dict[Any, List[Parameter]] = {}
+        self._out_tree_for: Dict[Any, Any] = {}
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, **kwargs) -> None:
+        self._active = active
+        self._clear_cached_op()
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self) -> None:
+        self._cached_op_params = None
+        self._raw_cache = {}
+        self._aux_params_for = {}
+        self._out_tree_for = {}
+
+    def cast(self, dtype) -> None:
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args) -> None:
+        """Resolve deferred parameter shapes from input shapes. Layers with
+        deferred-init parameters override this (the reference resolves it
+        generically through symbolic infer_shape passes)."""
+        raise MXNetError(
+            f"{self.__class__.__name__} has parameters with deferred "
+            "(unknown) shapes but does not implement infer_shape(); "
+            "specify in_units/in_channels explicitly")
+
+    # -- eager path ---------------------------------------------------------
+    def forward(self, x, *args):
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(x, *args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- cached (jitted) path -----------------------------------------------
+    def __call__(self, *args):
+        if self._active and not _in_trace():
+            return self._call_cached_op(*args)
+        return super().__call__(*args)
+
+    def _call_cached_op(self, *args):
+        if self._cached_op_params is None:
+            params = list(self.collect_params().values())
+            if any(p._data is None for p in params):
+                # first call: run eagerly to resolve deferred shapes (the
+                # reference's first-call shape/type/storage passes)
+                out = super().__call__(*args)
+                return out
+            self._cached_op_params = params
+        params = self._cached_op_params
+        flat_in: List[Any] = []
+        in_tree = _flatten_nds(args, flat_in)
+        training = autograd.is_training()
+        cache_key = (training, in_tree)
+        raw = self._raw_cache.get(cache_key)
+        if raw is None:
+            raw = self._build_raw(training, in_tree, len(flat_in), cache_key)
+            self._raw_cache[cache_key] = raw
+        datas = [a._data if isinstance(a, NDArray) else a for a in flat_in]
+        datas += [p.data()._data for p in params]
+        datas.append(_random._next_key())
+
+        from ..ndarray.ndarray import _parents_of
+        parent_arrays = list(flat_in) + [p.data() for p in params] + [None]
+        parents = _parents_of(
+            [a if isinstance(a, NDArray) else None for a in parent_arrays])
+        result, node = autograd.invoke(raw, datas, parents,
+                                       f"CachedOp[{self.name}]", has_aux=True)
+        outs, aux = result
+        # write mutated aux state back into the real parameters
+        aux_params = self._aux_params_for[cache_key]
+        with autograd.pause():
+            for p, v in zip(aux_params, aux):
+                p.set_data(v)
+        out_nds = []
+        for i, o in enumerate(outs):
+            r = NDArray(o)
+            if node is not None:
+                r._ag = (node, i)
+            out_nds.append(r)
+        res = _unflatten_nds(self._out_tree_for[cache_key], out_nds, [0])
+        return res[0] if len(res) == 1 else res
+
+    def _build_raw(self, training: bool, in_tree, n_in: int, cache_key):
+        params = self._cached_op_params
+        block = self
+
+        def raw(*datas):
+            xs = list(datas[:n_in])
+            ps = datas[n_in:n_in + len(params)]
+            key = datas[-1]
+            for p, d in zip(params, ps):
+                p._bind_tracer(d)
+            _random.push_trace_key(key)
+            _TRACE_DEPTH.depth = getattr(_TRACE_DEPTH, "depth", 0) + 1
+            try:
+                with autograd.pause(train_mode=training):
+                    wrapped = [NDArray(x) if not isinstance(x, NDArray)
+                               else x for x in xs]
+                    args = _unflatten_nds(in_tree, wrapped, [0])
+                    out = block.forward(*args)
+            finally:
+                _TRACE_DEPTH.depth -= 1
+                _random.pop_trace_key()
+                new_vals = [p._unbind_tracer() for p in params]
+            aux_params, aux_vals = [], []
+            for p, d, nv in zip(params, ps, new_vals):
+                if nv is not d:
+                    aux_params.append(p)
+                    aux_vals.append(nv)
+            block._aux_params_for[cache_key] = aux_params
+            flat_out: List[Any] = []
+            out_tree = _flatten_nds((out,) if isinstance(out, NDArray)
+                                    else out, flat_out)
+            block._out_tree_for[cache_key] = out_tree
+            return (tuple(o._data if isinstance(o, NDArray) else o
+                          for o in flat_out), tuple(aux_vals))
+
+        return jax.jit(raw)
+
+    # -- deploy -------------------------------------------------------------
+    def export(self, path: str, epoch: int = 0) -> None:
+        """Save params in the reference's export layout
+        (``prefix-%04d.params``); graph JSON comes from mxtpu.symbol."""
+        params = {}
+        for name, p in self._collect_params_with_prefix().items():
+            params["arg:" + name] = p.data()
+        nd.save(f"{path}-{epoch:04d}.params", params)

@@ -1,0 +1,5 @@
+"""Data loading (reference ``python/mxnet/gluon/data/``)."""
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision

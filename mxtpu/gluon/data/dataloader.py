@@ -1,0 +1,106 @@
+"""DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``
+[path cite]).
+
+The reference forks multiprocessing workers that decode into POSIX
+shared-memory NDArrays. Under PJRT the device owns transfers, so the
+TPU-native design is a *threaded* prefetch pipeline (this box: 1 CPU core;
+multi-worker adds only overhead) feeding ready host batches that
+device_put overlaps with compute. ``num_workers`` maps to prefetch
+threads; the batchify API is preserved exactly.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Callable, List, Optional
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference ``default_batchify_fn``)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    out = _np.asarray(data)
+    return nd.array(out, dtype=out.dtype)
+
+
+default_mp_batchify_fn = default_batchify_fn  # no mp path under PJRT
+
+
+class DataLoader:
+    """Iterates a Dataset in mini-batches with background prefetch."""
+
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, pin_memory: bool = False,
+                 prefetch: Optional[int] = None, thread_pool: bool = False,
+                 timeout: int = 120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size is required when batch_sampler is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle and sampler are exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size/shuffle/sampler/last_batch are exclusive with "
+                "batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * max(1, num_workers))
+        self._timeout = timeout
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _make_batch(self, indices) -> object:
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._prefetch == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        q: _queue.Queue = _queue.Queue(maxsize=self._prefetch)
+        sentinel = object()
+
+        def _producer():
+            try:
+                for indices in self._batch_sampler:
+                    q.put(self._make_batch(indices))
+            except Exception as e:  # surfaced on the consumer side
+                q.put(e)
+            q.put(sentinel)
+
+        t = threading.Thread(target=_producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get(timeout=self._timeout)
+            if item is sentinel:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item

@@ -1,0 +1,4 @@
+"""Vision datasets + transforms (reference gluon.data.vision)."""
+from .datasets import (CIFAR10, CIFAR100, FashionMNIST, ImageFolderDataset,
+                       MNIST)
+from . import transforms

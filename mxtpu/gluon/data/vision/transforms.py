@@ -1,0 +1,186 @@
+"""Vision transforms (reference
+``python/mxnet/gluon/data/vision/transforms.py`` [path cite]).
+
+Transforms are HybridBlocks operating on HWC uint8 images (dataset layout)
+and producing CHW float tensors, exactly like the reference.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import HybridSequential, Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom", "RandomBrightness",
+           "RandomContrast"]
+
+
+class Compose(Sequential):
+    """Sequentially composed transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def hybrid_forward(self, F, x):
+        out = x.astype("float32") / 255.0
+        if out.ndim == 3:
+            return out.transpose((2, 0, 1))
+        return out.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = _np.asarray(self._mean, "float32").reshape(-1, 1, 1)
+        std = _np.asarray(self._std, "float32").reshape(-1, 1, 1)
+        return (x - nd.array(mean)) / nd.array(std)
+
+
+def _resize_nd(x: NDArray, size) -> NDArray:
+    import jax.image
+    if isinstance(size, int):
+        size = (size, size)
+    h, w = size[1], size[0]  # reference Resize takes (w, h)
+    if x.ndim == 3:
+        new_shape = (h, w, x.shape[2])
+    else:
+        new_shape = (x.shape[0], h, w, x.shape[3])
+    from ....ndarray.ndarray import apply_op
+    return apply_op(
+        lambda a: jax.image.resize(a.astype("float32"), new_shape,
+                                   method="linear").astype(a.dtype),
+        [x], "imresize")
+
+
+class Resize(HybridBlock):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def hybrid_forward(self, F, x):
+        return _resize_nd(x, self._size)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3:-1] if x.ndim == 3 else x.shape[1:3]
+        if H < h or W < w:
+            x = _resize_nd(x, (max(w, W), max(h, H)))
+            H, W = (x.shape[0], x.shape[1]) if x.ndim == 3 else x.shape[1:3]
+        y0 = (H - h) // 2
+        x0 = (W - w) // 2
+        if x.ndim == 3:
+            return x[y0:y0 + h, x0:x0 + w, :]
+        return x[:, y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        w, h = self._size
+        if self._pad:
+            p = self._pad
+            pads = [(p, p), (p, p), (0, 0)] if x.ndim == 3 else \
+                [(0, 0), (p, p), (p, p), (0, 0)]
+            x = nd.array(_np.pad(x.asnumpy(), pads))
+        H, W = (x.shape[0], x.shape[1]) if x.ndim == 3 else x.shape[1:3]
+        y0 = int(_np.random.randint(0, H - h + 1))
+        x0 = int(_np.random.randint(0, W - w + 1))
+        if x.ndim == 3:
+            return x[y0:y0 + h, x0:x0 + w, :]
+        return x[:, y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        H, W = (x.shape[0], x.shape[1]) if x.ndim == 3 else x.shape[1:3]
+        area = H * W
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            w = int(round(_np.sqrt(target_area * aspect)))
+            h = int(round(_np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                y0 = int(_np.random.randint(0, H - h + 1))
+                x0 = int(_np.random.randint(0, W - w + 1))
+                crop = x[y0:y0 + h, x0:x0 + w, :] if x.ndim == 3 else \
+                    x[:, y0:y0 + h, x0:x0 + w, :]
+                return _resize_nd(crop, self._size)
+        return _resize_nd(x, self._size)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return nd.flip(x, axis=1 if x.ndim == 3 else 2)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return nd.flip(x, axis=0 if x.ndim == 3 else 1)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._brightness = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._brightness, self._brightness)
+        return (x.astype("float32") * alpha).clip(0, 255).astype(x.dtype)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._contrast = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._contrast, self._contrast)
+        xf = x.astype("float32")
+        gray_mean = float(xf.mean().asscalar())
+        return ((xf - gray_mean) * alpha + gray_mean).clip(0, 255) \
+            .astype(x.dtype)

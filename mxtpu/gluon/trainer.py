@@ -1,0 +1,105 @@
+"""Gluon Trainer (reference ``python/mxnet/gluon/trainer.py`` [path cite]).
+
+Applies an Optimizer to a set of Parameters each step. The reference
+orchestrates per-GPU grad reduction through KVStore; here a parameter is
+one logical (possibly mesh-sharded) array, so ``allreduce_grads`` is a
+no-op single-process and a psum under a distributed kvstore.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params: Optional[Dict] = None,
+                 kvstore: Union[str, Any] = "device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a ParameterDict/dict/list")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError(f"expected Parameter, got {type(p)}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                     **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+        self._kvstore = None
+        self._kv_initialized = False
+        self._kvstore_type = kvstore
+        self._contains_sparse = False
+
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._optimizer.learning_rate = lr
+
+    def _init_kvstore(self) -> None:
+        if isinstance(self._kvstore_type, str):
+            if self._kvstore_type.startswith("dist") or \
+                    self._kvstore_type == "tpu_sync":
+                from .. import kvstore as kv
+                self._kvstore = kv.create(self._kvstore_type)
+        else:
+            self._kvstore = self._kvstore_type
+        self._kv_initialized = True
+
+    def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        """Rescale grads by 1/batch_size, reduce, and update parameters."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self) -> None:
+        if self._kvstore is not None and hasattr(self._kvstore,
+                                                 "allreduce_grads"):
+            self._kvstore.allreduce_grads(self._params)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    f"parameter {p.name} not initialized before step()")
+            updater(i, p.grad(), p.data())
+
+    def zero_grad(self) -> None:
+        for p in self._params:
+            p.zero_grad()
+
+    # -- optimizer-state checkpointing (reference save_states/load_states) --
+    def save_states(self, fname: str) -> None:
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname: str) -> None:
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

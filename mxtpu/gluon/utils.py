@@ -1,0 +1,90 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py`` [path cite])."""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional
+
+from .. import ndarray as nd
+from ..context import Context
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Split a batch along ``batch_axis`` into ``num_slice`` chunks."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"batch size {size} not divisible by {num_slice} slices; "
+            "set even_split=False")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Split a batch across contexts (reference API). On TPU the idiomatic
+    scale-out is a sharded single array (mxtpu.parallel), but the per-ctx
+    list API is preserved for reference scripts."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Rescale arrays so their joint L2 norm ≤ max_norm
+    (reference ``gluon.utils.clip_global_norm``)."""
+    import jax.numpy as jnp
+    total = None
+    for a in arrays:
+        sq = jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+        total = sq if total is None else total + sq
+    norm = jnp.sqrt(total)
+    norm_f = float(norm)
+    if check_isfinite and not (norm_f == norm_f and abs(norm_f) != float("inf")):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+    scale = max_norm / (norm_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return norm_f
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path: Optional[str] = None, overwrite: bool = False,
+             sha1_hash: Optional[str] = None, retries: int = 5,
+             verify_ssl: bool = True) -> str:
+    """Download helper (reference API). This environment has no network
+    egress; succeeds only if the file is already on disk."""
+    fname = path if path and not os.path.isdir(path) else os.path.join(
+        path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        f"cannot download {url}: no network egress in this environment; "
+        f"place the file at {fname} manually")

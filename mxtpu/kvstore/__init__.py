@@ -1,0 +1,133 @@
+"""KVStore — parameter synchronization (reference ``python/mxnet/kvstore/``
++ ``src/kvstore/`` [path cite]).
+
+Backend map for the TPU rebuild (SURVEY.md §2.5):
+
+- ``local`` / ``device`` / ``nccl``: single-process. The reference reduces
+  per-GPU gradient copies (CommCPU/CommDevice/NCCL); here a parameter is
+  ONE logical jax.Array (possibly sharded over the local mesh), so
+  aggregation is the identity — push stores, pull returns. API semantics
+  (init/push/pull accumulating multiple pushed values per key) are kept so
+  reference scripts and the kvstore tests behave identically.
+- ``dist_sync`` / ``dist_device_sync`` / ``tpu_sync``: multi-process via
+  jax.distributed + psum over the global mesh (mxtpu.parallel); push+pull
+  fuses to an all-reduce inside the jitted step.
+- ``dist_async``: parameter-server semantics — see mxtpu.kvstore.server.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    """Single-process key-value store (reference ``KVStoreLocal``)."""
+
+    def __init__(self, kv_type: str = "local"):
+        self.type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+
+    # -- core API -----------------------------------------------------------
+    def init(self, key, value) -> None:
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            self._store[k] = v.copy() if isinstance(v, NDArray) else nd.array(v)
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            agg = vals[0]
+            for extra in vals[1:]:
+                agg = agg + extra
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                self._store[k] = agg.copy()
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True) -> None:
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._set_data(self._store[k]._data.astype(t.dtype))
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense fallback; true sharded-embedding path in mxtpu.sparse
+        self.pull(key, out, priority)
+
+    # -- optimizer ----------------------------------------------------------
+    def set_updater(self, updater: Callable) -> None:
+        self._updater = updater
+
+    def set_optimizer(self, optimizer) -> None:
+        from .. import optimizer as opt
+        self._optimizer = opt.create(optimizer)
+        self._updater = opt.get_updater(self._optimizer)
+
+    def set_gradient_compression(self, compression_params) -> None:
+        # ICI bandwidth makes 2-bit compression a non-goal; API preserved
+        self._compression = dict(compression_params)
+
+    # -- cluster topology (single-process values) ----------------------------
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def barrier(self) -> None:
+        nd.waitall()
+
+    def save_optimizer_states(self, fname: str, dump_optimizer=False) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return list(key), list(value)
+        return [key], [value]
+
+
+def create(name: str = "local") -> KVStore:
+    """Create a KVStore (reference ``mx.kv.create``)."""
+    name = name.lower()
+    if name in ("local", "device", "nccl", "local_allreduce_cpu",
+                "local_allreduce_device"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_device_sync", "dist_async", "tpu_sync",
+                "horovod"):
+        from .dist import DistKVStore
+        return DistKVStore(name)
+    raise ValueError(f"unknown kvstore type {name!r}")

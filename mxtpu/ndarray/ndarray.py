@@ -163,6 +163,10 @@ class NDArray:
     def __repr__(self):
         return f"{self.asnumpy()!r}\n<NDArray {self.shape} @{self.context}>"
 
+    def __reduce__(self):
+        # pickle via host numpy (optimizer-state checkpoints, kvstore)
+        return (_unpickle_ndarray, (self.asnumpy(),))
+
     def __iter__(self):
         for i in range(len(self)):
             yield self[i]
@@ -435,6 +439,10 @@ class NDArray:
         return self
 
 
+def _unpickle_ndarray(np_val):
+    return NDArray(jnp.asarray(np_val))
+
+
 # ---------------------------------------------------------------------------
 # creation
 # ---------------------------------------------------------------------------
@@ -450,10 +458,15 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
     if isinstance(source, NDArray):
         source = source._data
     if dtype is None:
-        if isinstance(source, (_np.ndarray, jax.Array)):
+        if isinstance(source, jax.Array):
             dtype = source.dtype
+        elif isinstance(source, _np.ndarray):
+            # reference semantics (python/mxnet/ndarray/ndarray.py array()):
+            # float32 default unless the source is an NDArray; integer/bool
+            # numpy inputs keep their dtype (indexing use-cases)
+            dtype = source.dtype if source.dtype.kind in "iub" \
+                else _np.float32
         else:
-            # reference mx.nd.array defaults python lists/scalars to float32
             dtype = _np.float32
     np_val = _np.asarray(source, dtype_np(dtype))
     return NDArray(jax.device_put(np_val, _device(ctx)))

@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+import numpy as _np
 
 from .. import autograd
 from ..base import dtype_np
@@ -734,11 +735,14 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
 
     def _f(x):
         if pool_type == "max":
-            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-            return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+            # literal init value keeps reduce_window on the known
+            # max-monoid path (differentiable; maps to TPU pooling)
+            init = -_np.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else int(jnp.iinfo(x.dtype).min)
+            return lax.reduce_window(x, init, lax.max,
                                      dims, strides, pads)
-        s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
-                              dims, strides, pads)
+        s = lax.reduce_window(x, 0.0 if jnp.issubdtype(x.dtype, jnp.floating)
+                              else 0, lax.add, dims, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad:
@@ -763,6 +767,7 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     train mode (autograd.is_training) and moving stats otherwise.
     """
     use_batch_stats = autograd.is_training() and not use_global_stats
+    axis = axis % data.ndim
     red = tuple(i for i in range(data.ndim) if i != axis)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
@@ -863,6 +868,90 @@ def softmax_cross_entropy(data, label, **kwargs):
         oh = jax.nn.one_hot(l.astype(jnp.int32), x.shape[-1], dtype=lp.dtype)
         return -jnp.sum(oh * lp)
     return apply_op(_f, [data, label], "softmax_cross_entropy")
+
+
+@register_op("ctc_loss", aliases=("CTCLoss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             blank_label="first", **kwargs):
+    """CTC negative log-likelihood (reference src/operator/nn/ctc_loss.cc /
+    warp-ctc). ``data`` is (T, N, C) activations (softmax applied inside),
+    ``label`` (N, L) class indices, 0 = padding when blank is 'first'.
+
+    TPU-native: the standard log-alpha forward recursion expressed as
+    ``lax.scan`` over time — static shapes, no host sync, differentiable by
+    jax AD (no hand-written backward needed).
+    """
+    if blank_label != "first":
+        raise NotImplementedError(
+            "ctc_loss: only blank_label='first' (blank=class 0, labels "
+            "1-based) is implemented; 'last' is not yet supported")
+    arrs = [data, label]
+    has_dl = data_lengths is not None
+    has_ll = label_lengths is not None
+    if has_dl:
+        arrs.append(data_lengths)
+    if has_ll:
+        arrs.append(label_lengths)
+    blank = 0  # 'first' convention: class 0 is blank, labels are 1-based
+
+    def _f(x, lab, *rest):
+        T, N, C = x.shape
+        L = lab.shape[1]
+        ri = 0
+        dl = rest[ri].astype(jnp.int32) if has_dl else jnp.full((N,), T, jnp.int32)
+        ri += 1 if has_dl else 0
+        ll = rest[ri].astype(jnp.int32) if has_ll else \
+            jnp.sum((lab > 0).astype(jnp.int32), axis=1)
+        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        lab_i = lab.astype(jnp.int32)
+        # extended label seq: blank, l1, blank, l2, ... blank  (len S=2L+1)
+        S = 2 * L + 1
+        ext = jnp.full((N, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab_i)
+        neg_inf = jnp.float32(-1e30)
+        # allow skip when ext[s] != blank and ext[s] != ext[s-2]
+        can_skip = jnp.concatenate(
+            [jnp.zeros((N, 2), bool),
+             (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])],
+            axis=1)[:, :S]
+        alpha0 = jnp.full((N, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        if L > 0:
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        def step(alpha, logp_t):
+            stay = alpha
+            prev1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)[:, :S]
+            prev2 = jnp.where(can_skip, prev2, neg_inf)
+            m = jnp.maximum(jnp.maximum(stay, prev1), prev2)
+            tot = m + jnp.log(
+                jnp.exp(stay - m) + jnp.exp(prev1 - m) + jnp.exp(prev2 - m))
+            tot = jnp.where(m <= neg_inf / 2, neg_inf, tot)
+            emit = jnp.take_along_axis(logp_t, ext, axis=1)
+            return tot + emit, tot + emit
+
+        _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T,N,S)
+        # pick alpha at t = dl-1, s = 2*ll and 2*ll-1
+        t_idx = jnp.clip(dl - 1, 0, T - 1)
+        a_last = jnp.take_along_axis(
+            alphas, t_idx[None, :, None].repeat(S, axis=2), axis=0)[0]
+        s1 = jnp.clip(2 * ll, 0, S - 1)
+        s2 = jnp.clip(2 * ll - 1, 0, S - 1)
+        a1 = jnp.take_along_axis(a_last, s1[:, None], axis=1)[:, 0]
+        a2 = jnp.take_along_axis(a_last, s2[:, None], axis=1)[:, 0]
+        # empty labels: the only valid path ends at s=0 — don't count it
+        # twice through the clipped s2 index
+        a2 = jnp.where(ll > 0, a2, neg_inf)
+        m = jnp.maximum(a1, a2)
+        ll_total = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m))
+        return -ll_total
+
+    return apply_op(_f, arrs, "ctc_loss")
 
 
 # -- misc -------------------------------------------------------------------

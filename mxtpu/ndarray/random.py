@@ -41,8 +41,28 @@ def seed(seed_state: int, ctx: str = "all") -> None:
 
 def _next_key():
     st = _key_state()
+    trace = getattr(st, "trace_keys", None)
+    if trace:
+        # inside a hybridize/jit trace: split functionally from the traced
+        # key so every compiled step draws fresh randomness (the reference's
+        # per-device Philox stream advanced inside the engine op)
+        trace[-1], sub = jax.random.split(trace[-1])
+        return sub
     st.key, sub = jax.random.split(st.key)
     return sub
+
+
+def push_trace_key(key) -> None:
+    """Enter traced-RNG mode: subsequent sampling splits from ``key``
+    (a jax tracer) instead of the process-global stateful seed."""
+    st = _key_state()
+    if not hasattr(st, "trace_keys"):
+        st.trace_keys = []
+    st.trace_keys.append(key)
+
+
+def pop_trace_key():
+    return _key_state().trace_keys.pop()
 
 
 def current_key():

@@ -16,5 +16,9 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import jax  # noqa: E402  (after env setup)
 
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")
 # float32 tests compare against NumPy ground truth — use exact f32 matmuls
 jax.config.update("jax_default_matmul_precision", "highest")

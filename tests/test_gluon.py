@@ -1,0 +1,238 @@
+"""Gluon Block/Parameter/layer tests (modeled on the reference
+tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+from mxtpu.test_utils import assert_almost_equal, with_seed
+
+
+@with_seed()
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu())
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_ctx() == [mx.cpu(0)]
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+@with_seed()
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    w = params.get("weight", shape=(10, 10))
+    assert w.name == "net_weight"
+    assert "net_weight" in params
+    # shape merging with unknown dims
+    w2 = params.get("weight", shape=(10, 0))
+    assert w2 is w and w.shape == (10, 10)
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params", strip_prefix="net_")
+    params2 = gluon.ParameterDict("net_")
+    params2.get("weight", shape=(10, 10))
+    params2.load("/tmp/test_paramdict.params", restore_prefix="net_")
+    assert_almost_equal(w.data().asnumpy(),
+                        params2["net_weight"].data().asnumpy())
+
+
+@with_seed()
+def test_dense():
+    net = nn.Dense(8, in_units=4, activation="relu")
+    net.initialize()
+    x = mx.nd.array(np.random.randn(16, 4))
+    out = net(x)
+    assert out.shape == (16, 8)
+    assert float(out.asnumpy().min()) >= 0  # relu applied
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    expect = np.maximum(x.asnumpy() @ w.T + b, 0)
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_dense_deferred_init():
+    net = nn.Dense(8)
+    net.initialize()
+    # shape unknown until first forward
+    assert net.weight.shape == (8, 0)
+    out = net(mx.nd.ones((2, 3, 5)))  # flatten => in_units 15
+    assert net.weight.shape == (8, 15)
+    assert out.shape == (2, 8)
+
+
+@with_seed()
+def test_sequential_and_naming():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(2))
+    names = list(net.collect_params().keys())
+    assert names == ["model_dense0_weight", "model_dense0_bias",
+                     "model_dense1_weight", "model_dense1_bias"]
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    assert len(net[0:1]) == 1
+
+
+@with_seed()
+def test_conv2d():
+    net = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 16, 16))
+    out = net(x)
+    assert out.shape == (2, 8, 16, 16)
+    # deferred in_channels
+    net2 = nn.Conv2D(4, kernel_size=3, strides=2)
+    net2.initialize()
+    out2 = net2(x)
+    assert net2.weight.shape == (4, 3, 3, 3)
+    assert out2.shape == (2, 4, 7, 7)
+
+
+@with_seed()
+def test_pool_layers():
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(pool_size=4, strides=4)(x).shape == (2, 3, 2, 2)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    got = nn.GlobalMaxPool2D()(x).asnumpy()
+    assert_almost_equal(got, x.asnumpy().max(axis=(2, 3), keepdims=True))
+
+
+@with_seed()
+def test_batchnorm_running_stats():
+    net = nn.BatchNorm(in_channels=4)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(8, 4, 3, 3) * 3 + 1)
+    with autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    rv = net.running_var.data().asnumpy()
+    batch_mean = x.asnumpy().mean(axis=(0, 2, 3))
+    batch_var = x.asnumpy().var(axis=(0, 2, 3))
+    assert_almost_equal(rm, 0.1 * batch_mean, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(rv, 0.9 + 0.1 * batch_var, rtol=1e-3, atol=1e-3)
+    # inference uses running stats (not batch stats)
+    out = net(x).asnumpy()
+    expect = (x.asnumpy() - rm.reshape(1, -1, 1, 1)) / \
+        np.sqrt(rv.reshape(1, -1, 1, 1) + 1e-5)
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-3)
+
+
+@with_seed()
+def test_hybridize_consistency():
+    """Same numbers hybridized vs eager (the reference's CachedOp
+    consistency guarantee)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(4, 7))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    net(x)  # first call resolves cache
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_hybridize_grad_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(4, 5))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_eager = net[0].weight.grad().asnumpy()
+    net.hybridize()
+    net(x)  # build cache
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_hybrid = net[0].weight.grad().asnumpy()
+    assert_almost_equal(g_eager, g_hybrid, rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_save_load_parameters(tmp_path):
+    f = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 4))
+    expect = net(x).asnumpy()
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    assert_almost_equal(net2(x).asnumpy(), expect)
+
+
+@with_seed()
+def test_embedding_layer():
+    net = nn.Embedding(10, 6)
+    net.initialize()
+    idx = mx.nd.array(np.array([[1, 2], [3, 4]]), dtype="int32")
+    out = net(idx)
+    assert out.shape == (2, 2, 6)
+    w = net.weight.data().asnumpy()
+    assert_almost_equal(out.asnumpy()[0, 0], w[1])
+
+
+@with_seed()
+def test_layernorm_groupnorm():
+    x = mx.nd.array(np.random.randn(4, 6, 5))
+    ln = nn.LayerNorm()
+    ln.initialize()
+    out = ln(x).asnumpy()
+    expect = (x.asnumpy() - x.asnumpy().mean(-1, keepdims=True)) / \
+        np.sqrt(x.asnumpy().var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-4)
+    gn = nn.GroupNorm(num_groups=3)
+    gn.initialize()
+    assert gn(mx.nd.array(np.random.randn(2, 6, 4, 4))).shape == (2, 6, 4, 4)
+
+
+@with_seed()
+def test_block_apply_cast():
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16
+
+
+@with_seed()
+def test_prelu_swish_elu():
+    x = mx.nd.array(np.random.randn(3, 4))
+    for layer in [nn.PReLU(), nn.ELU(), nn.SELU(), nn.GELU(), nn.Swish(),
+                  nn.LeakyReLU(0.1)]:
+        layer.initialize()
+        assert layer(x).shape == x.shape
+
+
+@with_seed()
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "const", mx.nd.array(np.array([1.0, 2.0])))
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = Net()
+    net.initialize()
+    out = net(mx.nd.ones((3, 2)))
+    assert_almost_equal(out.asnumpy(), np.tile([1.0, 2.0], (3, 1)))

@@ -1,0 +1,94 @@
+"""Data pipeline tests (reference tests/python/unittest/test_gluon_data.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import data as gdata
+from mxtpu.gluon.data.vision import MNIST, transforms
+from mxtpu.test_utils import assert_almost_equal, with_seed
+
+
+def test_array_dataset():
+    X = np.random.randn(10, 3).astype("float32")
+    y = np.arange(10).astype("float32")
+    ds = gdata.ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    assert len(ds) == 10
+    item = ds[3]
+    assert_almost_equal(item[0].asnumpy(), X[3])
+    assert float(item[1]) == 3.0
+
+
+def test_dataset_transform():
+    ds = gdata.ArrayDataset(mx.nd.array(np.ones((4, 2), "float32")),
+                            mx.nd.array(np.zeros(4, "float32")))
+    t = ds.transform_first(lambda x: x * 2)
+    assert float(t[0][0].asnumpy().sum()) == 4.0
+
+
+def test_samplers():
+    seq = list(gdata.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = sorted(gdata.RandomSampler(5))
+    assert rnd == [0, 1, 2, 3, 4]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(5), 2, "keep")
+    assert [len(b) for b in bs] == [2, 2, 1]
+    assert len(bs) == 3
+    bs = gdata.BatchSampler(gdata.SequentialSampler(5), 2, "discard")
+    assert [len(b) for b in bs] == [2, 2]
+
+
+@with_seed()
+def test_dataloader():
+    X = np.random.randn(10, 3).astype("float32")
+    y = np.arange(10).astype("float32")
+    ds = gdata.ArrayDataset(X, y)
+    loader = gdata.DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    assert_almost_equal(yb.asnumpy(), np.array([0, 1, 2, 3], "float32"))
+    # shuffled loader covers all samples
+    loader = gdata.DataLoader(ds, batch_size=5, shuffle=True, num_workers=1)
+    seen = np.sort(np.concatenate([b[1].asnumpy() for b in loader]))
+    assert_almost_equal(seen, y)
+
+
+def test_mnist_synthetic():
+    ds = MNIST(train=True, synthetic=True, synthetic_size=64)
+    assert len(ds) == 64
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert img.dtype == np.uint8
+    assert 0 <= label < 10
+    # deterministic
+    ds2 = MNIST(train=True, synthetic=True, synthetic_size=64)
+    assert_almost_equal(ds[5][0].asnumpy(), ds2[5][0].asnumpy())
+
+
+def test_transforms():
+    img = mx.nd.array(np.random.randint(0, 255, (28, 28, 3)), dtype="uint8")
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 28, 28)
+    assert t.dtype == np.float32
+    assert float(t.asnumpy().max()) <= 1.0
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))(t)
+    assert norm.shape == (3, 28, 28)
+    resized = transforms.Resize(14)(img)
+    assert resized.shape == (14, 14, 3)
+    cropped = transforms.CenterCrop(20)(img)
+    assert cropped.shape == (20, 20, 3)
+    comp = transforms.Compose([transforms.ToTensor(),
+                               transforms.Normalize(0.5, 0.5)])
+    assert comp(img).shape == (3, 28, 28)
+
+
+@with_seed()
+def test_dataloader_with_transform():
+    ds = MNIST(train=True, synthetic=True, synthetic_size=32) \
+        .transform_first(transforms.ToTensor())
+    loader = gdata.DataLoader(ds, batch_size=8)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (8, 1, 28, 28)
+    assert xb.dtype == np.float32

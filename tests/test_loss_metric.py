@@ -1,0 +1,133 @@
+"""Loss + metric tests (reference tests/python/unittest/test_loss.py,
+test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon, metric
+from mxtpu.gluon import loss as gloss
+from mxtpu.test_utils import assert_almost_equal, with_seed
+
+
+@with_seed()
+def test_l2_l1_loss():
+    pred = mx.nd.array(np.random.randn(4, 3))
+    label = mx.nd.array(np.random.randn(4, 3))
+    l2 = gloss.L2Loss()(pred, label).asnumpy()
+    expect = 0.5 * ((pred.asnumpy() - label.asnumpy()) ** 2).mean(axis=1)
+    assert_almost_equal(l2, expect, rtol=1e-5, atol=1e-6)
+    l1 = gloss.L1Loss()(pred, label).asnumpy()
+    assert_almost_equal(l1, np.abs(pred.asnumpy() - label.asnumpy()).mean(1),
+                        rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_softmax_ce_loss():
+    logits = np.random.randn(6, 5).astype("float32")
+    labels = np.random.randint(0, 5, 6)
+    L = gloss.SoftmaxCrossEntropyLoss()(
+        mx.nd.array(logits), mx.nd.array(labels)).asnumpy()
+    logp = logits - logits.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    expect = -logp[np.arange(6), labels]
+    assert_almost_equal(L, expect, rtol=1e-4, atol=1e-5)
+    # dense labels
+    dense = np.eye(5, dtype="float32")[labels]
+    L2 = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        mx.nd.array(logits), mx.nd.array(dense)).asnumpy()
+    assert_almost_equal(L2, expect, rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_bce_kl_losses():
+    pred = mx.nd.array(np.random.randn(4, 3))
+    label = mx.nd.array((np.random.rand(4, 3) > 0.5).astype("float32"))
+    L = gloss.SigmoidBCELoss()(pred, label).asnumpy()
+    p = pred.asnumpy()
+    expect = (np.maximum(p, 0) - p * label.asnumpy() +
+              np.log1p(np.exp(-np.abs(p)))).mean(1)
+    assert_almost_equal(L, expect, rtol=1e-4, atol=1e-5)
+    # KL
+    logits = mx.nd.array(np.random.randn(4, 3))
+    target = mx.nd.array(np.random.dirichlet(np.ones(3), 4).astype("float32"))
+    kl = gloss.KLDivLoss(from_logits=False)(logits, target).asnumpy()
+    assert np.all(np.isfinite(kl))
+
+
+@with_seed()
+def test_huber_hinge_losses():
+    pred = mx.nd.array(np.random.randn(5, 2))
+    label = mx.nd.array(np.random.randn(5, 2))
+    for L in [gloss.HuberLoss(), gloss.HingeLoss(), gloss.SquaredHingeLoss(),
+              gloss.LogisticLoss()]:
+        out = L(pred, label).asnumpy()
+        assert out.shape == (5,)
+        assert np.all(np.isfinite(out))
+
+
+@with_seed()
+def test_ctc_loss_basic():
+    # uniform logits over C classes: loss = -log P(label path) is finite
+    T, N, C, L = 10, 2, 5, 3
+    pred = mx.nd.zeros((N, T, C))
+    label = mx.nd.array(np.array([[1, 2, 3], [2, 2, 0]], dtype="float32"))
+    loss = gloss.CTCLoss()(pred, label).asnumpy()
+    assert loss.shape == (N,)
+    assert np.all(loss > 0) and np.all(np.isfinite(loss))
+
+
+def test_ctc_loss_edge_cases():
+    from mxtpu.ndarray import ops
+    T, N, C = 6, 2, 4
+    pred = mx.nd.zeros((T, N, C))
+    # empty labels: loss = -T*log softmax(blank) = T*log(C) for uniform logits
+    loss = ops.ctc_loss(pred, mx.nd.zeros((N, 3))).asnumpy()
+    assert_almost_equal(loss, np.full(N, T * np.log(C)), rtol=1e-4, atol=1e-5)
+    # zero-column label matrix
+    loss0 = ops.ctc_loss(pred, mx.nd.zeros((N, 0))).asnumpy()
+    assert_almost_equal(loss0, np.full(N, T * np.log(C)), rtol=1e-4,
+                        atol=1e-5)
+    with pytest.raises(NotImplementedError):
+        ops.ctc_loss(pred, mx.nd.zeros((N, 3)), blank_label="last")
+
+
+def test_accuracy_metric():
+    m = metric.Accuracy()
+    pred = mx.nd.array(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]]))
+    label = mx.nd.array(np.array([1, 0, 0]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(2.0 / 3)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_f1_metrics():
+    pred = mx.nd.array(np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]]))
+    label = mx.nd.array(np.array([1, 2]))
+    m = metric.TopKAccuracy(top_k=2)
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+    f1 = metric.F1()
+    f1.update([mx.nd.array([1, 0, 1])],
+              [mx.nd.array(np.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7]]))])
+    assert f1.get()[1] == pytest.approx(1.0)
+
+
+def test_mse_perplexity_composite():
+    pred = mx.nd.array(np.array([[0.6, 0.4], [0.2, 0.8]]))
+    label = mx.nd.array(np.array([0, 1]))
+    ce = metric.create("ce")
+    ce.update([label], [pred])
+    expect = -(np.log(0.6) + np.log(0.8)) / 2
+    assert ce.get()[1] == pytest.approx(expect, rel=1e-5)
+    comp = metric.create(["acc", "ce"])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert names == ["accuracy", "cross-entropy"]
+    assert values[0] == pytest.approx(1.0)
+
+
+def test_custom_metric():
+    m = metric.np(lambda label, pred: float(np.abs(label - pred).sum()))
+    m.update([mx.nd.ones((2,))], [mx.nd.zeros((2,))])
+    assert m.get()[1] == pytest.approx(2.0)

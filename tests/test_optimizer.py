@@ -1,0 +1,117 @@
+"""Optimizer tests vs NumPy reference updates (modeled on the reference
+tests/python/unittest/test_optimizer.py technique: compare against a
+Python/NumPy re-implementation)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import optimizer as opt
+from mxtpu.test_utils import assert_almost_equal, with_seed
+
+
+def _run_steps(optimizer, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        optimizer.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+@with_seed()
+def test_sgd_matches_numpy():
+    w0 = np.random.randn(5, 3).astype("float32")
+    grads = [np.random.randn(5, 3).astype("float32") for _ in range(4)]
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.01,
+                   rescale_grad=1.0 / 8)
+    got = _run_steps(o, w0, grads)
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for g in grads:
+        gg = g / 8 + 0.01 * w
+        mom = 0.9 * mom - 0.1 * gg
+        w = w + mom
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_sgd_clip_gradient():
+    w0 = np.zeros((4,), dtype="float32")
+    g = np.array([10.0, -10.0, 0.5, -0.5], dtype="float32")
+    o = opt.create("sgd", learning_rate=1.0, clip_gradient=1.0)
+    got = _run_steps(o, w0, [g])
+    assert_almost_equal(got, -np.clip(g, -1, 1))
+
+
+@with_seed()
+def test_adam_matches_numpy():
+    w0 = np.random.randn(6).astype("float32")
+    grads = [np.random.randn(6).astype("float32") for _ in range(5)]
+    o = opt.create("adam", learning_rate=0.01, wd=0.1)
+    got = _run_steps(o, w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        gg = g + 0.1 * w
+        m = b1 * m + (1 - b1) * gg
+        v = b2 * v + (1 - b2) * gg * gg
+        lr_t = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_rmsprop_adagrad_adadelta_run():
+    w0 = np.random.randn(4, 4).astype("float32")
+    grads = [np.random.randn(4, 4).astype("float32") for _ in range(3)]
+    for name in ["rmsprop", "adagrad", "adadelta", "ftrl", "signum", "nag",
+                 "lamb", "adamw"]:
+        o = opt.create(name)
+        got = _run_steps(o, w0, grads)
+        assert got.shape == w0.shape
+        assert np.all(np.isfinite(got)), name
+        assert not np.allclose(got, w0), f"{name} did not move weights"
+
+
+@with_seed()
+def test_lr_scheduler_hookup():
+    from mxtpu import lr_scheduler
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    o = opt.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.array(np.ones(2, dtype="float32"))
+    for _ in range(6):
+        o.update(0, w, mx.nd.array(np.zeros(2, dtype="float32")), None)
+    assert o.learning_rate < 1.0
+
+
+def test_lr_mult_wd_mult():
+    o = opt.create("sgd", learning_rate=1.0, wd=0.1)
+    o.set_lr_mult({0: 0.5})
+    o.set_wd_mult({0: 0.0})
+    assert o._get_lr(0) == 0.5
+    assert o._get_wd(0) == 0.0
+    assert o._get_lr(1) == 1.0
+
+
+@with_seed()
+def test_updater_states_roundtrip(tmp_path):
+    o = opt.create("adam")
+    upd = opt.get_updater(o)
+    w = mx.nd.array(np.random.randn(3).astype("float32"))
+    upd(0, mx.nd.array(np.ones(3, dtype="float32")), w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.create("adam"))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+
+
+@with_seed()
+def test_multi_precision_sgd():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   multi_precision=True)
+    w = mx.nd.array(np.random.randn(4), dtype="float16")
+    state = o.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and state[0].dtype == np.float32
+    o.update_multi_precision(0, w, mx.nd.array(np.ones(4), dtype="float16"),
+                             state)
+    assert w.dtype == np.float16

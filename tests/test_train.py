@@ -1,0 +1,74 @@
+"""End-to-end convergence tests (reference tests/python/train/): train a
+small net, assert accuracy above threshold — the cheap signal that
+autograd + layers + optimizer + data loading compose."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, metric
+from mxtpu.gluon import nn
+from mxtpu.gluon.data import DataLoader
+from mxtpu.gluon.data.vision import MNIST, transforms
+from mxtpu.test_utils import with_seed
+
+
+@with_seed()
+def test_mlp_convergence():
+    """Logistic-regression-able blobs learned by an MLP to >95%."""
+    rng = np.random.RandomState(0)
+    n, d, k = 512, 16, 4
+    centers = rng.randn(k, d) * 3
+    labels = rng.randint(0, k, n)
+    X = centers[labels] + rng.randn(n, d)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(k))
+    net.initialize(init="xavier")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    Xb = mx.nd.array(X.astype("float32"))
+    yb = mx.nd.array(labels.astype("float32"))
+    for _ in range(60):
+        with autograd.record():
+            out = net(Xb)
+            L = loss_fn(out, yb).mean()
+        L.backward()
+        trainer.step(n)
+    acc = metric.Accuracy()
+    acc.update([yb], [net(Xb)])
+    assert acc.get()[1] > 0.95, f"accuracy {acc.get()[1]}"
+
+
+@with_seed()
+@pytest.mark.slow
+def test_lenet_mnist_convergence():
+    """LeNet on (synthetic) MNIST — the BASELINE config-1 exit test shape."""
+    train_ds = MNIST(train=True, synthetic=True, synthetic_size=1024) \
+        .transform_first(transforms.ToTensor())
+    loader = DataLoader(train_ds, batch_size=128, shuffle=True)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 5, activation="relu"), nn.MaxPool2D(),
+                nn.Conv2D(16, 3, activation="relu"), nn.MaxPool2D(),
+                nn.Flatten(), nn.Dense(64, activation="relu"),
+                nn.Dense(10))
+    net.initialize(init="xavier")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.003})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(6):
+        for xb, yb in loader:
+            with autograd.record():
+                out = net(xb)
+                L = loss_fn(out, yb).mean()
+            L.backward()
+            trainer.step(xb.shape[0])
+    acc = metric.Accuracy()
+    test_ds = MNIST(train=False, synthetic=True, synthetic_size=256) \
+        .transform_first(transforms.ToTensor())
+    for xb, yb in DataLoader(test_ds, batch_size=128):
+        acc.update([yb], [net(xb)])
+    assert acc.get()[1] > 0.9, f"accuracy {acc.get()[1]}"
