@@ -86,21 +86,38 @@ class DataLoader:
             return
         q: _queue.Queue = _queue.Queue(maxsize=self._prefetch)
         sentinel = object()
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # bounded put that gives up when the consumer is gone, so an
+            # abandoned iterator (break/exception mid-epoch) can't pin
+            # the producer thread + in-flight batches forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def _producer():
             try:
                 for indices in self._batch_sampler:
-                    q.put(self._make_batch(indices))
+                    if stop.is_set() or not _put(self._make_batch(indices)):
+                        return
             except Exception as e:  # surfaced on the consumer side
-                q.put(e)
-            q.put(sentinel)
+                _put(e)
+            _put(sentinel)
 
         t = threading.Thread(target=_producer, daemon=True)
         t.start()
-        while True:
-            item = q.get(timeout=self._timeout)
-            if item is sentinel:
-                break
-            if isinstance(item, Exception):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get(timeout=self._timeout)
+                if item is sentinel:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()

@@ -227,6 +227,14 @@ class BatchNorm(HybridBlock):
                     running_mean * m + batch_mean.detach() * (1 - m))
                 self.running_var.set_data(
                     running_var * m + batch_var.detach() * (1 - m))
+            # normalize with the stats just computed (use_global_stats
+            # makes the op consume them as-is) instead of letting the op
+            # reduce over x a second time; grads flow through the batch
+            # stats as true batch-norm gradients require
+            return F.BatchNorm(x, gamma, beta, batch_mean, batch_var,
+                               eps=self._epsilon, momentum=self._momentum,
+                               fix_gamma=not self._scale,
+                               use_global_stats=True, axis=self._axis)
         return F.BatchNorm(x, gamma, beta, running_mean, running_var,
                            eps=self._epsilon, momentum=self._momentum,
                            fix_gamma=not self._scale,

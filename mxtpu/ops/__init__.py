@@ -1,0 +1,13 @@
+"""mxtpu.ops — TPU-native fused kernels (Pallas + structured lax).
+
+The reference's hand-written CUDA/cuDNN kernels (``src/operator/nn/``,
+``src/operator/contrib/transformer.cc`` [path cite]) map here: most ops
+are jnp/lax compositions that XLA fuses; this package holds the ones
+that need explicit structure — attention (flash/ring), and future
+sharded-embedding / fused-optimizer kernels.
+"""
+from .attention import (blockwise_attention, dense_attention,
+                        flash_attention, ring_attention)
+
+__all__ = ["blockwise_attention", "dense_attention", "flash_attention",
+           "ring_attention"]

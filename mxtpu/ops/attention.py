@@ -1,0 +1,223 @@
+"""Attention kernels: dense, blockwise (flash-style online softmax),
+Pallas flash on TPU, and ring attention over the ``sp`` mesh axis.
+
+NEW components with no reference counterpart (SURVEY.md §5.7: MXNet
+predates sequence parallelism; nearest in-tree artifact is the
+interleaved MHA contrib op, ``src/operator/contrib/transformer.cc``
+[path cite]). Design per the ring-attention recipe: blockwise attention
+with running (max, denom, numerator) statistics; the ring variant
+rotates KV shards around the sequence axis with ``lax.ppermute`` inside
+``shard_map``, overlapping compute with ICI transfers.
+
+All functions take (batch, num_heads, seq, head_dim) arrays. GQA is
+supported: kv arrays may have fewer heads (num_heads % kv_heads == 0).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["dense_attention", "blockwise_attention", "flash_attention",
+           "ring_attention"]
+
+_NEG_INF = -1e30  # finite "minus infinity": keeps fully-masked rows NaN-free
+
+
+def _repeat_kv(q, k, v):
+    """Broadcast grouped KV heads up to the query head count (GQA)."""
+    hq, hk = q.shape[1], k.shape[1]
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
+
+
+def dense_attention(q, k, v, *, causal: bool = False,
+                    mask: Optional[jax.Array] = None,
+                    scale: Optional[float] = None,
+                    q_offset: int = 0, kv_offset: int = 0):
+    """Reference-semantics attention, fully materialized scores.
+
+    ``q_offset``/``kv_offset`` are the global positions of element 0 —
+    used by the ring variant where each device holds a sequence shard.
+    """
+    k, v = _repeat_kv(q, k, v)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    allowed = None
+    if causal:
+        qpos = jnp.arange(q.shape[2]) + q_offset
+        kpos = jnp.arange(k.shape[2]) + kv_offset
+        allowed = (qpos[:, None] >= kpos[None, :])[None, None]
+    if mask is not None:
+        allowed = mask if allowed is None else (allowed & mask)
+    if allowed is None:
+        probs = jax.nn.softmax(scores, axis=-1)
+    else:
+        # masked softmax with fully-masked rows → zeros (matches the
+        # blockwise/ring _finalize semantics), not uniform attention
+        scores = jnp.where(allowed, scores, _NEG_INF)
+        e = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+        e = jnp.where(allowed, e, 0.0)
+        denom = e.sum(axis=-1, keepdims=True)
+        probs = e / jnp.where(denom == 0.0, 1.0, denom)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _online_block(q, k, v, m, l, o, scale, causal, q_off, kv_off,
+                  extra_mask=None):
+    """One flash step: fold a KV block into running (m, l, o) stats.
+
+    m: (b,h,q) running row max; l: (b,h,q) running denominator;
+    o: (b,h,q,d) running unnormalized numerator. All float32.
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    allowed = None
+    if causal:
+        qpos = jnp.arange(q.shape[2]) + q_off
+        kpos = jnp.arange(k.shape[2]) + kv_off
+        allowed = (qpos[:, None] >= kpos[None, :])[None, None]
+    if extra_mask is not None:
+        allowed = extra_mask if allowed is None else (allowed & extra_mask)
+    if allowed is not None:
+        scores = jnp.where(allowed, scores, _NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    if allowed is not None:
+        # fully-masked rows keep m_new == _NEG_INF, where exp(score -
+        # m_new) == 1 would silently attend uniformly — zero them so l
+        # stays 0 and _finalize emits zeros for such rows
+        p = jnp.where(allowed, p, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o, dtype):
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
+    return (o / l[..., None]).astype(dtype)
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None,
+                        kv_block: int = 512,
+                        q_offset: int = 0, kv_offset: int = 0):
+    """Flash-style attention as a ``lax.scan`` over KV blocks: O(seq)
+    memory, MXU-friendly block matmuls, no materialized score matrix."""
+    k, v = _repeat_kv(q, k, v)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    kv_block = min(kv_block, skv)
+    nblk, rem = divmod(skv, kv_block)
+    if rem:  # pad KV to a block multiple; padded keys are masked by offset
+        pad = kv_block - rem
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        nblk += 1
+    else:
+        pad = 0
+
+    kb = k.reshape(b, h, nblk, kv_block, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblk, kv_block, d).transpose(2, 0, 1, 3, 4)
+
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+
+    def body(carry, xs):
+        m, l, o = carry
+        i, kblk, vblk = xs
+        blk_off = kv_offset + i * kv_block
+        # padded tail keys: positions >= kv_offset+skv are masked out
+        kpos = jnp.arange(kv_block) + blk_off
+        valid = kpos < kv_offset + skv
+        m2, l2, o2 = _online_block(
+            q, kblk, vblk, m, l, o, scale, causal, q_offset,
+            blk_off, extra_mask=valid[None, None, None, :])
+        return (m2, l2, o2), None
+
+    (m, l, o), _ = lax.scan(body, (m0, l0, o0),
+                            (jnp.arange(nblk), kb, vb))
+    return _finalize(m, l, o, q.dtype)
+
+
+def _tpu_pallas_flash(q, k, v, causal, scale):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _pl_flash)
+    return _pl_flash(q, k, v, causal=causal, sm_scale=scale)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    kv_block: int = 512):
+    """Fused attention: Pallas (Mosaic) kernel on TPU, blockwise scan
+    elsewhere. This is the rebuild's hot-path attention op — the role
+    cuDNN's fused MHA played in the reference."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    kr, vr = _repeat_kv(q, k, v)
+    if q.ndim == 4 and jax.default_backend() == "tpu":
+        # Mosaic wants block-aligned seq lens; fall back otherwise.
+        sq, skv, d = q.shape[2], kr.shape[2], q.shape[3]
+        if sq % 128 == 0 and skv % 128 == 0 and d % 128 == 0:
+            try:
+                return _tpu_pallas_flash(q, kr, vr, causal, scale)
+            except Exception:
+                pass
+    return blockwise_attention(q, kr, vr, causal=causal, scale=scale,
+                               kv_block=kv_block)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp",
+                   causal: bool = False,
+                   scale: Optional[float] = None,
+                   kv_block: int = 512):
+    """Ring attention over the ``axis_name`` mesh axis.
+
+    Call INSIDE ``shard_map`` where q/k/v hold this device's sequence
+    shard. Each of the ``n`` ring steps computes blockwise attention of
+    the local Q against the currently-held KV shard, then rotates KV to
+    the next device with ``ppermute`` — total memory O(seq/n), ICI
+    traffic fully overlapped by XLA's async collective scheduling.
+    """
+    k, v = _repeat_kv(q, k, v)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+
+    # derive the running stats from q so they inherit q's varying-
+    # manual-axes set (jax>=0.8 types carries by vma; fresh zeros would
+    # be unvarying and fail the fori_loop carry check)
+    zero = (q[..., 0] * 0).astype(jnp.float32)
+    m0 = zero + _NEG_INF
+    l0 = zero
+    o0 = (q * 0).astype(jnp.float32)
+
+    def body(i, carry):
+        m, l, o, kc, vc = carry
+        # after i rotations (shift=+1) this device holds the shard that
+        # started on device (my - i) mod n
+        kv_idx = (my - i) % n
+        q_off = my * sq
+        kv_off = kv_idx * skv
+        m, l, o = _online_block(q, kc, vc, m, l, o, scale, causal,
+                                q_off, kv_off)
+        src_dst = [(j, (j + 1) % n) for j in range(n)]
+        kc = lax.ppermute(kc, axis_name, src_dst)
+        vc = lax.ppermute(vc, axis_name, src_dst)
+        return m, l, o, kc, vc
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    return _finalize(m, l, o, q.dtype)

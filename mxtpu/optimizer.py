@@ -125,8 +125,13 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    @staticmethod
+    def _is_low_precision(weight) -> bool:
+        # fp16 as in the reference, plus bfloat16 (the TPU-native half)
+        return str(weight.dtype) in ("float16", "bfloat16")
+
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype in (_np.float16,):
+        if self.multi_precision and self._is_low_precision(weight):
             master = weight.astype("float32")
             return (master, self.create_state(index, master))
         return self.create_state(index, weight)
@@ -135,8 +140,7 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and isinstance(state, tuple) and \
-                isinstance(state[0], NDArray):
+        if self.multi_precision and self._is_low_precision(weight):
             master, inner = state
             self.update(index, master, grad.astype("float32"), inner)
             weight._set_data(master._data.astype(weight.dtype))
@@ -153,19 +157,15 @@ class Optimizer:
 
 # ---------------------------------------------------------------------------
 # jitted update kernels — hyperparams passed as jax scalars so lr changes
-# never retrace; weight/state buffers donated (in-place on TPU)
+# never retrace. Buffers are NOT donated here: NDArrays may alias these
+# jax buffers (views, user refs); in-place HBM reuse is the hybridized
+# train-step path's job (mxtpu.parallel.step donates whole TrainStates).
 # ---------------------------------------------------------------------------
 def _prep(g, w, rescale, clip, wd):
     g = g * rescale
     if clip is not None:
         g = jnp.clip(g, -clip, clip)
     return g + wd * w
-
-
-def _make_kernel(fn, n_state, has_clip):
-    """jit ``fn(w, grads_states..., scalars...)`` donating w + states."""
-    return jax.jit(fn, donate_argnums=tuple(range(n_state + 1)),
-                   static_argnums=())
 
 
 @jax.jit

@@ -1,0 +1,27 @@
+"""mxtpu.parallel — device mesh, shardings, collectives, distributed
+bootstrap, and the sharded train step (SURVEY.md §2.4/§2.5/§7).
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack: KVStore comm trees + NCCL + ps-lite + launch.py
+(``src/kvstore/``, ``3rdparty/ps-lite/`` [path cite]) become a named
+``jax.sharding.Mesh`` + XLA collectives + ``jax.distributed``.
+"""
+from .mesh import (MESH_AXES, MeshConfig, axis_size, create_mesh,
+                   current_mesh, mesh_axes, use_mesh)
+from .sharding import (P, ShardingRules, batch_spec, constrain, named,
+                       replicated, shard_pytree)
+from .collectives import (allgather, allreduce, alltoall, axis_index,
+                          barrier_sync, pmean, ppermute_ring, reduce_scatter)
+from .step import TrainState, init_state, make_eval_step, make_train_step
+from . import dist
+
+__all__ = [
+    "MESH_AXES", "MeshConfig", "axis_size", "create_mesh", "current_mesh",
+    "mesh_axes", "use_mesh",
+    "P", "ShardingRules", "batch_spec", "constrain", "named", "replicated",
+    "shard_pytree",
+    "allgather", "allreduce", "alltoall", "axis_index", "barrier_sync",
+    "pmean", "ppermute_ring", "reduce_scatter",
+    "TrainState", "init_state", "make_eval_step", "make_train_step",
+    "dist",
+]

@@ -1,0 +1,62 @@
+"""Collective primitives over the mesh — the rebuild of the reference's
+KVStore reduce/broadcast machinery (``src/kvstore/comm.h`` CommCPU/
+CommDevice tree reduce, ``kvstore_nccl.h`` NCCL allreduce [path cite])
+as XLA collectives that compile onto ICI/DCN.
+
+These are thin, *named* wrappers so framework code reads like the
+reference ("allreduce gradients over the data axis") while lowering to
+``jax.lax`` psum/all_gather/ppermute inside ``shard_map``/``pjit``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["allreduce", "pmean", "allgather", "reduce_scatter",
+           "ppermute_ring", "alltoall", "axis_index", "barrier_sync"]
+
+Axis = Union[str, Sequence[str]]
+
+
+def allreduce(x, axis: Axis = "dp"):
+    """Sum over mesh axis (reference: KVStore push+pull fused)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: Axis = "dp"):
+    return lax.pmean(x, axis)
+
+
+def allgather(x, axis: Axis, dim: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: Axis, dim: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+def ppermute_ring(x, axis: str, shift: int = 1):
+    """Rotate shards around ``axis`` (ring attention's KV rotation)."""
+    n = lax.psum(1, axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def alltoall(x, axis: str, split_dim: int, concat_dim: int):
+    """Ulysses-style head↔sequence reshard."""
+    return lax.all_to_all(x, axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=True)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def barrier_sync():
+    """Host-level barrier: block until all live jax arrays are done —
+    the rebuild's ``Engine::WaitForAll`` (reference
+    ``src/engine/threaded_engine.cc`` [path cite])."""
+    jax.effects_barrier()

@@ -1,0 +1,120 @@
+"""Device-mesh management — the TPU rebuild's replacement for the
+reference's device lists + KVStore topology (SURVEY.md §2.4/§2.5;
+reference ``src/kvstore/comm.h``, ``gpu_topology.h`` [path cite]).
+
+Where MXNet enumerated ``ctx=[gpu(0)..gpu(N)]`` and reduced gradients
+between them, the TPU-native design names a logical
+``jax.sharding.Mesh`` over all devices with up to five axes:
+
+- ``dp`` — data parallel (batch sharding; gradients psum over it)
+- ``fsdp`` — fully-sharded data parallel (param+optimizer sharding)
+- ``tp`` — tensor/model parallel (Megatron-style weight sharding)
+- ``sp`` — sequence/context parallel (ring attention over this axis)
+- ``pp`` — pipeline parallel (layer stages)
+- ``ep`` — expert parallel (MoE experts)
+
+XLA then inserts the collectives (psum/all-gather/reduce-scatter/ppermute)
+that the reference implemented by hand in NCCL/ps-lite, and lays them on
+ICI within a slice / DCN across slices.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["MeshConfig", "create_mesh", "current_mesh", "use_mesh",
+           "mesh_axes", "axis_size", "MESH_AXES"]
+
+# canonical axis order: collectives over leftmost axes cross the slowest-
+# varying device dimension → keep dp outermost (DCN-friendly), tp/sp
+# innermost (ICI-friendly, highest bandwidth demand).
+MESH_AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical parallelism layout. Unspecified axes default to 1.
+
+    ``dp=-1`` means "absorb all remaining devices" (exactly one axis may
+    be -1)."""
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "pp": self.pp,
+                 "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"only one mesh axis may be -1, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+_state = threading.local()
+
+
+def create_mesh(config: Optional[MeshConfig] = None,
+                devices: Optional[Sequence] = None,
+                **axis_sizes) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` over ``devices`` (default: all).
+
+    ``create_mesh(dp=2, tp=4)`` or ``create_mesh(MeshConfig(tp=4))``.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis kwargs, not both")
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The mesh installed by :func:`use_mesh` (or None)."""
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Install ``mesh`` as the ambient mesh (also enters jax's own
+    mesh context so bare ``pjit``/``with_sharding_constraint`` resolve)."""
+    prev = current_mesh()
+    _state.mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def mesh_axes(mesh: Optional[Mesh] = None) -> Tuple[str, ...]:
+    mesh = mesh or current_mesh()
+    return tuple(mesh.axis_names) if mesh is not None else ()
+
+
+def axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]

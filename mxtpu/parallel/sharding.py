@@ -1,0 +1,95 @@
+"""Sharding rules: name-pattern → PartitionSpec, the TPU-native analogue
+of the reference's per-parameter KVStore key placement
+(``src/kvstore/kvstore_dist.h`` key sharding [path cite]).
+
+The reference sharded parameter-server keys by range over server nodes;
+here a rule table maps parameter names (regex) to ``PartitionSpec`` over
+the logical mesh axes, and XLA materializes the layout. This is the t5x/
+maxtext "logical axis rules" pattern, kept deliberately small.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["P", "ShardingRules", "named", "shard_pytree", "constrain",
+           "replicated", "batch_spec"]
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    """``named(mesh, 'dp', None)`` → NamedSharding(mesh, P('dp', None))."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_spec(mesh: Optional[Mesh] = None) -> P:
+    """Canonical batch sharding: leading dim over (dp, fsdp)."""
+    return P(("dp", "fsdp"))
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) table.
+
+    >>> rules = ShardingRules([
+    ...     (r".*attn.*(wq|wk|wv)$", P("fsdp", "tp")),
+    ...     (r".*w_embed$",          P("tp", "fsdp")),
+    ...     (r".*",                  P()),
+    ... ])
+    >>> rules.spec("layer3_attn_wq")   # first match wins
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]]):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec(self, name: str) -> P:
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return spec
+        return P()
+
+    def sharding(self, mesh: Mesh, name: str) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(name))
+
+    def tree_specs(self, tree: Any, prefix: str = "") -> Any:
+        """Map a pytree of arrays to a matching pytree of PartitionSpecs,
+        using '/'-joined key paths as names."""
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        specs = []
+        for path, _leaf in paths_and_leaves:
+            name = prefix + "/".join(_key_str(k) for k in path)
+            specs.append(self.spec(name))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+def shard_pytree(tree: Any, mesh: Mesh, rules: "ShardingRules",
+                 prefix: str = "") -> Any:
+    """device_put every leaf with its rule-derived NamedSharding — the
+    rebuild's ``kv.init`` (replicate/shard params onto the mesh)."""
+    specs = rules.tree_specs(tree, prefix)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+
+
+def constrain(x, *spec):
+    """``with_sharding_constraint`` under the ambient mesh; no-op outside
+    jit or when the mesh lacks the named axes."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except Exception:
+        return x

@@ -15,6 +15,9 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402  (after env setup)
 
+# The ambient axon sitecustomize force-registers the TPU plugin and
+# overrides JAX_PLATFORMS from the env; the config update below wins.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 

@@ -115,3 +115,35 @@ def test_multi_precision_sgd():
     o.update_multi_precision(0, w, mx.nd.array(np.ones(4), dtype="float16"),
                              state)
     assert w.dtype == np.float16
+
+
+@with_seed()
+def test_multi_precision_fp32_weights_untouched():
+    """multi_precision=True with fp32 weights must behave exactly like a
+    plain update — the (master, inner) unpacking applies only to low-
+    precision weights (regression: Adam's tuple state was misread as a
+    master-weight pair, overwriting weights with the first moment)."""
+    wnp = np.random.randn(4).astype("float32")
+    gnp = np.random.randn(4).astype("float32")
+    o_mp = opt.create("adam", learning_rate=0.1, multi_precision=True)
+    o_ref = opt.create("adam", learning_rate=0.1)
+    w1 = mx.nd.array(wnp)
+    w2 = mx.nd.array(wnp)
+    s1 = o_mp.create_state_multi_precision(0, w1)
+    s2 = o_ref.create_state(0, w2)
+    o_mp.update_multi_precision(0, w1, mx.nd.array(gnp), s1)
+    o_ref.update(0, w2, mx.nd.array(gnp), s2)
+    assert np.allclose(w1.asnumpy(), w2.asnumpy())
+
+
+@with_seed()
+def test_multi_precision_bfloat16():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   multi_precision=True)
+    w = mx.nd.array(np.random.randn(4).astype(np.float32),
+                    dtype="bfloat16")
+    state = o.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and state[0].dtype == np.float32
+    o.update_multi_precision(0, w, mx.nd.ones((4,), dtype="bfloat16"),
+                             state)
+    assert str(w.dtype) == "bfloat16"
