@@ -1,0 +1,73 @@
+"""Headline benchmark: ResNet-50 train throughput (img/s/chip).
+
+BASELINE.json metric #1. Runs the full jitted train step (forward,
+loss, backward, SGD+momentum update, donated buffers) on synthetic
+NHWC bf16 data — the reference's equivalent is
+``example/image-classification/benchmark_score.py`` + the
+``docs/faq/perf.md`` training tables [path cites — unverified].
+
+vs_baseline compares against the reference's recalled 1×V100 fp32
+figure (~360 img/s, BASELINE.md) — the only absolute single-device
+number the baseline provides.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_IMG_S = 360.0          # reference 1×V100 fp32 (BASELINE.md, recalled)
+
+
+def main():
+    from mxtpu.models import resnet
+    from mxtpu.parallel import mesh as pmesh, step as pstep
+    from mxtpu.parallel.sharding import ShardingRules, P
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    cfg = resnet.CONFIGS["resnet50"]
+    mesh = pmesh.create_mesh(dp=-1)          # all local devices on dp
+    rules = ShardingRules([(r".*", P())])    # replicate params
+
+    params = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = pstep.init_state(params, tx, mesh, rules)
+    train_step = pstep.make_train_step(
+        resnet.loss_fn(cfg), tx, mesh, rules, loss_has_aux=True)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, 224, 224, 3),
+                                             np.float32), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, cfg.num_classes, batch), jnp.int32)
+    data = {"image": images, "label": labels}
+
+    # warmup: compile + 2 steady steps
+    for _ in range(3):
+        state, loss, _ = train_step(state, data)
+    jax.block_until_ready(loss)
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss, _ = train_step(state, data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": round(img_s, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

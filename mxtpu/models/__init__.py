@@ -1,0 +1,16 @@
+"""mxtpu.models — flagship model families, TPU-first functional cores.
+
+The reference shipped its model breadth through
+``python/mxnet/gluon/model_zoo/`` (CNNs) and the GluonNLP ecosystem
+[path cite — unverified]. The rebuild keeps a Gluon model_zoo for API
+parity and, in addition, provides functional cores here: pure
+``forward(cfg, params, ...)`` functions over parameter pytrees that
+compose directly with ``mxtpu.parallel`` (sharding rules, jitted train
+step, remat, scan-over-layers) — the idiomatic shape for pjit/XLA.
+"""
+from . import llama
+from . import resnet
+from .llama import LlamaConfig
+from .resnet import ResNetConfig
+
+__all__ = ["llama", "resnet", "LlamaConfig", "ResNetConfig"]

@@ -1,0 +1,265 @@
+"""Llama-family transformer — the flagship LLM (BASELINE config 5:
+"Llama-3-8B ... stress hybridize→HLO at LLM scale").
+
+No reference counterpart exists (MXNet predates Llama; its nearest
+artifact is the interleaved-MHA contrib op,
+``src/operator/contrib/transformer.cc`` [path cite — unverified]), so
+this is a TPU-first design rather than a rebuild:
+
+- **functional core**: pure ``forward(cfg, params, tokens)`` over a
+  parameter pytree; composes with ``mxtpu.parallel.step`` for the
+  jitted, donated, mesh-sharded train step.
+- **scan-over-layers**: per-layer params are stacked on a leading layer
+  dim and the block is a ``lax.scan`` — HLO stays O(1) in depth, which
+  is what keeps Llama-8B trace/compile time sane (SURVEY.md §7.2.2).
+- **remat**: ``jax.checkpoint`` around each layer when
+  ``cfg.remat=True`` trades FLOPs for HBM (the reference's
+  mirror/memonger had the same role).
+- **GQA + RoPE + SwiGLU + RMSNorm**, bf16 activations / f32 params,
+  f32 logits for a stable softmax.
+- **parallelism-aware**: ``sharding_rules`` gives Megatron-style tp
+  sharding + fsdp; activations are sequence-sharded over ``sp`` and the
+  attention inner loop can run as ring attention
+  (``mxtpu.ops.attention.ring_attention``) under ``shard_map``.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import flash_attention, dense_attention, ring_attention
+from ..parallel.sharding import ShardingRules, constrain
+
+__all__ = ["LlamaConfig", "init_params", "forward", "loss_fn",
+           "sharding_rules", "CONFIGS"]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336          # SwiGLU inner dim
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16        # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "flash"         # flash | dense | ring
+    remat: bool = True
+    scan_layers: bool = True
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Named configs; llama3_8b is the BASELINE config-5 target, the small
+# ones are for tests/dryrun.
+CONFIGS: Dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                        remat=False),
+    "llama3_8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                             max_seq_len=8192),
+    "llama2_7b": LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=32, hidden_dim=11008,
+                             rope_theta=10000.0, max_seq_len=4096),
+}
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def _init_layer(key, cfg: LlamaConfig, n: int):
+    """Stacked params for n layers (leading dim = layer index)."""
+    hd = cfg.head_dim
+    ks = jax.random.split(key, 7)
+    d = cfg.param_dtype
+    # small-init (scaled by fan-in) — GPT-2/Llama style
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, d) / math.sqrt(fan_in))
+    return {
+        "attn_norm": jnp.ones((n, cfg.dim), d),
+        "wq": init(ks[0], (n, cfg.dim, cfg.n_heads * hd), cfg.dim),
+        "wk": init(ks[1], (n, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+        "wv": init(ks[2], (n, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+        "wo": init(ks[3], (n, cfg.n_heads * hd, cfg.dim),
+                   cfg.n_heads * hd * 2 * cfg.n_layers),
+        "ffn_norm": jnp.ones((n, cfg.dim), d),
+        "w_gate": init(ks[4], (n, cfg.dim, cfg.hidden_dim), cfg.dim),
+        "w_up": init(ks[5], (n, cfg.dim, cfg.hidden_dim), cfg.dim),
+        "w_down": init(ks[6], (n, cfg.hidden_dim, cfg.dim),
+                       cfg.hidden_dim * 2 * cfg.n_layers),
+    }
+
+
+def init_params(cfg: LlamaConfig, rng: Optional[jax.Array] = None):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    k_emb, k_layers, k_head = jax.random.split(rng, 3)
+    params = {
+        "tok_embed": jax.random.normal(
+            k_emb, (cfg.vocab_size, cfg.dim), cfg.param_dtype) * 0.02,
+        "layers": _init_layer(k_layers, cfg, cfg.n_layers),
+        "final_norm": jnp.ones((cfg.dim,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jax.random.normal(
+            k_head, (cfg.dim, cfg.vocab_size), cfg.param_dtype) \
+            / math.sqrt(cfg.dim)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# sharding
+# ---------------------------------------------------------------------------
+def sharding_rules(cfg: Optional[LlamaConfig] = None) -> ShardingRules:
+    """Megatron tp + fsdp placement. Layer-stacked params carry a
+    leading (unsharded) layer dim. Embedding rows over tp so the
+    one-hot matmul psums over tp; lm_head columns over tp (vocab-
+    parallel logits)."""
+    L = None  # leading layer axis of scanned params: never sharded
+    return ShardingRules([
+        (r"tok_embed$",        P("tp", "fsdp")),
+        (r"layers/w[qkv]$",    P(L, "fsdp", "tp")),   # column parallel
+        (r"layers/wo$",        P(L, "tp", "fsdp")),   # row parallel
+        (r"layers/w_(gate|up)$", P(L, "fsdp", "tp")),
+        (r"layers/w_down$",    P(L, "tp", "fsdp")),
+        (r"norm",              P()),
+        (r"lm_head$",          P("fsdp", "tp")),
+        (r".*",                P()),
+    ])
+
+
+# activation specs (sequence sharded over sp)
+_ACT = P(("dp", "fsdp"), "sp", None)            # (batch, seq, dim)
+_QKV = P(("dp", "fsdp"), "tp", "sp", None)      # (batch, heads, seq, hd)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def rms_norm(x, weight, eps):
+    x32 = x.astype(jnp.float32)
+    inv = lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def rope_tables(cfg: LlamaConfig, seq_len: int, offset: int = 0):
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta **
+                      (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    t = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                 # (seq, hd/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin):
+    """x: (b, h, s, hd); rotate-half convention."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
+    if cfg.attn_impl == "ring" and mesh is not None and "sp" in mesh.axis_names:
+        from jax.experimental.shard_map import shard_map
+        fn = shard_map(
+            partial(ring_attention, axis_name="sp", causal=True),
+            mesh=mesh, in_specs=(_QKV, _QKV, _QKV), out_specs=_QKV,
+            check_rep=False)
+        return fn(q, k, v)
+    if cfg.attn_impl == "dense":
+        return dense_attention(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, mesh, cos, sin, x, lp):
+    """One transformer block. x: (b, s, dim) in cfg.dtype."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = q.transpose(0, 2, 1, 3)    # (b, h, s, hd)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = constrain(q, *_QKV)
+    k = constrain(k, *_QKV)
+    v = constrain(v, *_QKV)
+    o = _attention(cfg, q, k, v, mesh)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    x = x + constrain(o @ lp["wo"].astype(dt), *_ACT)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    x = x + constrain((gate * up) @ lp["w_down"].astype(dt), *_ACT)
+    return x
+
+
+def forward(cfg: LlamaConfig, params, tokens,
+            mesh: Optional[Mesh] = None):
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab) f32."""
+    b, s = tokens.shape
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    x = constrain(x, *_ACT)
+    cos, sin = rope_tables(cfg, s)
+
+    layer = partial(_layer, cfg, mesh, cos, sin)
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+
+    if cfg.scan_layers:
+        def body(x, lp):
+            return layer(x, lp), None
+        x, _ = lax.scan(body, x, params["layers"])
+    else:
+        for i in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[i], params["layers"])
+            x = layer(x, lp)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return constrain(logits, ("dp", "fsdp"), "sp", None)
+
+
+# ---------------------------------------------------------------------------
+# loss
+# ---------------------------------------------------------------------------
+def loss_fn(cfg: LlamaConfig, mesh: Optional[Mesh] = None):
+    """Causal-LM loss for ``parallel.step.make_train_step``: batch is a
+    dict with 'tokens' (b, s) and optional 'mask' (b, s) — predicts
+    token t+1 from prefix ≤ t."""
+    def loss(params, batch):
+        tokens = batch["tokens"]
+        logits = forward(cfg, params, tokens, mesh=mesh)[:, :-1]
+        targets = tokens[:, 1:]
+        mask = batch.get("mask")
+        mask = (jnp.ones_like(targets, jnp.float32) if mask is None
+                else mask[:, 1:].astype(jnp.float32))
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss

@@ -1,0 +1,258 @@
+"""ResNet v1 — functional TPU-first core (BASELINE config 2, the
+"ResNet-50 img/s/chip" headline metric).
+
+The reference implements ResNet twice: symbolically
+(``example/image-classification/symbols/resnet.py``) and as Gluon
+blocks (``python/mxnet/gluon/model_zoo/vision/resnet.py``)
+[path cites — unverified]. This is the TPU-native re-design:
+
+- **NHWC layout** (channels-last) — what XLA:TPU tiles best onto the
+  MXU conv units; the reference's NCHW was a cuDNN choice.
+- **bf16 activations + f32 params/BN stats** — the v5e fast path.
+- pure functions over a param pytree → composes with
+  ``parallel.step.make_train_step`` (donated, dp/fsdp-sharded).
+- BatchNorm in train mode normalizes with batch statistics and returns
+  updated running stats as an auxiliary output (functional equivalent
+  of the reference's mutable aux params).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ResNetConfig", "init_params", "init_state", "forward",
+           "loss_fn", "CONFIGS"]
+
+# layers-per-stage, bottleneck?
+_SPECS = {
+    18: ([2, 2, 2, 2], False),
+    34: ([3, 4, 6, 3], False),
+    50: ([3, 4, 6, 3], True),
+    101: ([3, 4, 23, 3], True),
+    152: ([3, 8, 36, 3], True),
+}
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @property
+    def stages(self) -> List[int]:
+        return _SPECS[self.depth][0]
+
+    @property
+    def bottleneck(self) -> bool:
+        return _SPECS[self.depth][1]
+
+
+CONFIGS: Dict[str, ResNetConfig] = {
+    "resnet18": ResNetConfig(depth=18),
+    "resnet50": ResNetConfig(depth=50),
+    "resnet101": ResNetConfig(depth=101),
+    "tiny": ResNetConfig(depth=18, width=8, num_classes=10),
+}
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    std = math.sqrt(2.0 / fan_in)          # He init (reference MSRAPrelu)
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * std
+
+
+def _bn_params(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def _block_channels(cfg: ResNetConfig, stage: int) -> Tuple[int, int]:
+    mid = cfg.width * (2 ** stage)
+    out = mid * 4 if cfg.bottleneck else mid
+    return mid, out
+
+
+def init_params(cfg: ResNetConfig, rng: Optional[jax.Array] = None):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    d = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 256))
+    p: Dict[str, Any] = {
+        "stem_conv": _conv_init(next(keys), 7, 7, 3, cfg.width, d),
+        "stem_bn": _bn_params(cfg.width, d),
+    }
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stages):
+        mid, cout = _block_channels(cfg, s)
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            blk: Dict[str, Any] = {}
+            if cfg.bottleneck:
+                blk["conv1"] = _conv_init(next(keys), 1, 1, cin, mid, d)
+                blk["bn1"] = _bn_params(mid, d)
+                blk["conv2"] = _conv_init(next(keys), 3, 3, mid, mid, d)
+                blk["bn2"] = _bn_params(mid, d)
+                blk["conv3"] = _conv_init(next(keys), 1, 1, mid, cout, d)
+                blk["bn3"] = _bn_params(cout, d)
+            else:
+                blk["conv1"] = _conv_init(next(keys), 3, 3, cin, mid, d)
+                blk["bn1"] = _bn_params(mid, d)
+                blk["conv2"] = _conv_init(next(keys), 3, 3, mid, cout, d)
+                blk["bn2"] = _bn_params(cout, d)
+            if stride != 1 or cin != cout:
+                blk["down_conv"] = _conv_init(next(keys), 1, 1, cin, cout, d)
+                blk["down_bn"] = _bn_params(cout, d)
+            p[f"stage{s}_block{b}"] = blk
+            cin = cout
+    p["fc_w"] = jax.random.normal(
+        next(keys), (cin, cfg.num_classes), d) / math.sqrt(cin)
+    p["fc_b"] = jnp.zeros((cfg.num_classes,), d)
+    return p
+
+
+def init_state(cfg: ResNetConfig):
+    """Running BN statistics (the reference's aux params)."""
+    st: Dict[str, Any] = {"stem_bn": _bn_state(cfg.width)}
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stages):
+        mid, cout = _block_channels(cfg, s)
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            blk = ({"bn1": _bn_state(mid), "bn2": _bn_state(mid),
+                    "bn3": _bn_state(cout)} if cfg.bottleneck
+                   else {"bn1": _bn_state(mid), "bn2": _bn_state(cout)})
+            if stride != 1 or cin != cout:
+                blk["down_bn"] = _bn_state(cout)
+            st[f"stage{s}_block{b}"] = blk
+            cin = cout
+    return st
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _conv(x, w, stride=1, padding="SAME"):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _tree_get(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _apply_bn(cfg, x, p, st, train, updates, *path):
+    x32 = x.astype(jnp.float32)
+    if train:
+        mean = x32.mean(axis=(0, 1, 2))
+        var = x32.var(axis=(0, 1, 2))
+        if updates is not None:
+            m = cfg.bn_momentum
+            s = _tree_get(st, path)
+            updates[path] = {"mean": m * s["mean"] + (1 - m) * mean,
+                             "var": m * s["var"] + (1 - m) * var}
+    else:
+        s = _tree_get(st, path)
+        mean, var = s["mean"], s["var"]
+    inv = lax.rsqrt(var + cfg.bn_eps)
+    out = (x32 - mean) * inv * p["scale"].astype(jnp.float32) \
+        + p["bias"].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def forward(cfg: ResNetConfig, params, x, state=None, train: bool = False):
+    """x: (N, H, W, 3) → logits (N, classes) f32. In train mode returns
+    (logits, new_state) with EMA-updated running BN stats."""
+    if state is None:
+        state = init_state(cfg)
+    updates: Dict[Tuple[str, ...], Any] = {} if train else None
+    x = x.astype(cfg.dtype)
+
+    x = _conv(x, params["stem_conv"], stride=2)
+    x = _apply_bn(cfg, x, params["stem_bn"], state, train, updates, "stem_bn")
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                          (1, 2, 2, 1), "SAME")
+
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stages):
+        mid, cout = _block_channels(cfg, s)
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            name = f"stage{s}_block{b}"
+            blk = params[name]
+            sc = state[name]
+            shortcut = x
+            if "down_conv" in blk:
+                shortcut = _conv(x, blk["down_conv"], stride=stride)
+                shortcut = _apply_bn(cfg, shortcut, blk["down_bn"], state,
+                                     train, updates, name, "down_bn")
+            if cfg.bottleneck:
+                h = jax.nn.relu(_apply_bn(cfg, _conv(x, blk["conv1"]),
+                                          blk["bn1"], state, train, updates,
+                                          name, "bn1"))
+                h = jax.nn.relu(_apply_bn(cfg, _conv(h, blk["conv2"],
+                                                     stride=stride),
+                                          blk["bn2"], state, train, updates,
+                                          name, "bn2"))
+                h = _apply_bn(cfg, _conv(h, blk["conv3"]), blk["bn3"],
+                              state, train, updates, name, "bn3")
+            else:
+                h = jax.nn.relu(_apply_bn(cfg, _conv(x, blk["conv1"],
+                                                     stride=stride),
+                                          blk["bn1"], state, train, updates,
+                                          name, "bn1"))
+                h = _apply_bn(cfg, _conv(h, blk["conv2"]), blk["bn2"],
+                              state, train, updates, name, "bn2")
+            x = jax.nn.relu(h + shortcut)
+            cin = cout
+
+    x = x.mean(axis=(1, 2))            # global average pool
+    logits = (x.astype(jnp.float32) @ params["fc_w"].astype(jnp.float32)
+              + params["fc_b"].astype(jnp.float32))
+    if not train:
+        return logits
+    # fold flat updates back into a fresh nested state tree
+    new_state = jax.tree.map(lambda a: a, state)   # rebuilds dict nodes
+    for path, upd in updates.items():
+        node = new_state
+        for k in path[:-1]:
+            node = node[k]
+        node[path[-1]] = upd
+    return logits, new_state
+
+
+def loss_fn(cfg: ResNetConfig, state=None):
+    """Softmax cross-entropy over {'image','label'} batches; returns
+    (loss, new_bn_state) — use ``loss_has_aux=True`` in
+    ``make_train_step``."""
+    if state is None:
+        state = init_state(cfg)
+
+    def loss(params, batch):
+        logits, new_state = forward(cfg, params, batch["image"], state,
+                                    train=True)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, batch["label"][:, None].astype(jnp.int32), axis=-1)
+        return nll.mean(), new_state
+    return loss

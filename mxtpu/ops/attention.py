@@ -205,6 +205,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp",
     l0 = zero
     o0 = (q * 0).astype(jnp.float32)
 
+    from ..parallel.collectives import ppermute_ring
+
     def body(i, carry):
         m, l, o, kc, vc = carry
         # after i rotations (shift=+1) this device holds the shard that
@@ -214,9 +216,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp",
         kv_off = kv_idx * skv
         m, l, o = _online_block(q, kc, vc, m, l, o, scale, causal,
                                 q_off, kv_off)
-        src_dst = [(j, (j + 1) % n) for j in range(n)]
-        kc = lax.ppermute(kc, axis_name, src_dst)
-        vc = lax.ppermute(vc, axis_name, src_dst)
+        kc = ppermute_ring(kc, axis_name)
+        vc = ppermute_ring(vc, axis_name)
         return m, l, o, kc, vc
 
     m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))

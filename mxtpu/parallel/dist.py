@@ -22,6 +22,7 @@ __all__ = ["initialize", "is_initialized", "process_index", "process_count",
            "local_devices", "shutdown"]
 
 _initialized = False
+_client_started = False   # whether jax.distributed.initialize() actually ran
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -50,6 +51,8 @@ def initialize(coordinator_address: Optional[str] = None,
         num_processes=num_processes,
         process_id=process_id,
         local_device_ids=local_device_ids)
+    global _client_started
+    _client_started = True
     _initialized = True
 
 
@@ -70,7 +73,8 @@ def local_devices():
 
 
 def shutdown() -> None:
-    global _initialized
-    if _initialized and jax.process_count() > 1:
+    global _initialized, _client_started
+    if _client_started:
         jax.distributed.shutdown()
+    _client_started = False
     _initialized = False

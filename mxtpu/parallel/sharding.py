@@ -16,7 +16,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["P", "ShardingRules", "named", "shard_pytree", "constrain",
-           "replicated", "batch_spec"]
+           "replicated", "batch_spec", "key_str"]
 
 
 def named(mesh: Mesh, *spec) -> NamedSharding:
@@ -29,8 +29,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_spec(mesh: Optional[Mesh] = None) -> P:
-    """Canonical batch sharding: leading dim over (dp, fsdp)."""
-    return P(("dp", "fsdp"))
+    """Canonical batch sharding: leading dim over the data axes
+    (dp, fsdp) — filtered to the axes ``mesh`` actually has, so custom
+    meshes (e.g. ``('data','model')``) don't crash; with none of the
+    canonical axes present the batch replicates and the caller should
+    shard explicitly."""
+    axes = ("dp", "fsdp")
+    if mesh is not None:
+        axes = tuple(a for a in axes if a in mesh.axis_names)
+    return P(axes) if axes else P()
 
 
 class ShardingRules:
@@ -67,7 +74,9 @@ class ShardingRules:
         return jax.tree_util.tree_unflatten(treedef, specs)
 
 
-def _key_str(k) -> str:
+def key_str(k) -> str:
+    """Canonical string for one pytree path entry (shared by every
+    name-keyed pytree walk in mxtpu — keep this the single source)."""
     if hasattr(k, "key"):
         return str(k.key)
     if hasattr(k, "idx"):
@@ -75,6 +84,9 @@ def _key_str(k) -> str:
     if hasattr(k, "name"):
         return str(k.name)
     return str(k)
+
+
+_key_str = key_str  # internal alias
 
 
 def shard_pytree(tree: Any, mesh: Mesh, rules: "ShardingRules",
@@ -86,10 +98,35 @@ def shard_pytree(tree: Any, mesh: Mesh, rules: "ShardingRules",
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
 
 
+def _filter_spec(spec, axis_names) -> P:
+    """Drop axes the mesh doesn't have (model code names the full
+    dp/fsdp/sp/tp layout; smaller meshes ignore the missing axes)."""
+    names = set(axis_names)
+
+    def keep(e):
+        if e is None:
+            return None
+        if isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if a in names)
+            return kept if kept else None
+        return e if e in names else None
+
+    return P(*[keep(e) for e in spec])
+
+
 def constrain(x, *spec):
-    """``with_sharding_constraint`` under the ambient mesh; no-op outside
-    jit or when the mesh lacks the named axes."""
-    try:
-        return jax.lax.with_sharding_constraint(x, P(*spec))
-    except Exception:
+    """``with_sharding_constraint`` against the ambient mesh (mxtpu
+    ``use_mesh`` or jax's own mesh context). Explicit no-op when no mesh
+    is ambient; with a mesh present, spec errors (bad rank, unknown
+    axis style) propagate instead of being swallowed."""
+    from .mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is not None:
+        pspec = _filter_spec(spec, mesh.axis_names)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, pspec))
+    am = jax.sharding.get_abstract_mesh()
+    if not am.axis_names:          # no ambient mesh anywhere → no-op
         return x
+    return jax.lax.with_sharding_constraint(
+        x, _filter_spec(spec, am.axis_names))

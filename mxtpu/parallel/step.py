@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .sharding import ShardingRules, batch_spec
+from .sharding import ShardingRules, batch_spec, key_str
 
 __all__ = ["TrainState", "init_state", "make_train_step", "make_eval_step"]
 
@@ -39,17 +39,7 @@ class TrainState(NamedTuple):
 
 
 def _path_str(path) -> tuple:
-    out = []
-    for k in path:
-        if hasattr(k, "key"):
-            out.append(str(k.key))
-        elif hasattr(k, "idx"):
-            out.append(str(k.idx))
-        elif hasattr(k, "name"):
-            out.append(str(k.name))
-        else:
-            out.append(str(k))
-    return tuple(out)
+    return tuple(key_str(k) for k in path)
 
 
 def opt_state_shardings(tx, params: Any, mesh: Mesh,
@@ -86,12 +76,14 @@ def init_state(params: Any, tx, mesh: Mesh,
     sharded to match (per-param moments inherit their parameter's
     sharding; scalars replicate)."""
     pspecs = rules.tree_specs(params)
-    # copy first: the train step donates the state, and device_put may
-    # alias its input — donation must never delete the caller's arrays
-    params = jax.tree.map(
-        lambda x, s: jax.device_put(jnp.array(x, copy=True),
-                                    NamedSharding(mesh, s)),
-        params, pspecs)
+    # copy ON the target sharding: the train step donates the state (so
+    # the caller's arrays must never be aliased), and the copy must not
+    # stage through a single device — an fsdp/tp-sharded param larger
+    # than one device's HBM has to materialize directly sharded.
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                             is_leaf=lambda s: isinstance(s, P))
+    params = jax.jit(lambda t: jax.tree.map(jnp.copy, t),
+                     out_shardings=shardings)(params)
     oshard = opt_state_shardings(tx, params, mesh, rules)
     opt_state = jax.jit(tx.init, out_shardings=oshard)(params)
     step = jax.device_put(jnp.zeros((), jnp.int32),
@@ -150,6 +142,13 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                               state.params, updates)
+        # pin updated params to the rule-table layout so the state the
+        # next step receives is exactly the init_state placement (no
+        # XLA re-layout drift across steps)
+        params = jax.lax.with_sharding_constraint(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                 rules.tree_specs(params),
+                                 is_leaf=lambda s: isinstance(s, P)))
         new = TrainState(params, opt_state, state.step + 1)
         if loss_has_aux:
             return new, loss, aux
