@@ -38,9 +38,10 @@ def main():
 
     params = resnet.init_params(cfg, jax.random.PRNGKey(0))
     tx = optax.sgd(0.1, momentum=0.9)
-    state = pstep.init_state(params, tx, mesh, rules)
+    state = pstep.init_state(params, tx, mesh, rules,
+                             model_state=resnet.init_state(cfg))
     train_step = pstep.make_train_step(
-        resnet.loss_fn(cfg), tx, mesh, rules, loss_has_aux=True)
+        resnet.loss_fn(cfg), tx, mesh, rules, has_state=True)
 
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.standard_normal((batch, 224, 224, 3),
@@ -50,13 +51,13 @@ def main():
 
     # warmup: compile + 2 steady steps
     for _ in range(3):
-        state, loss, _ = train_step(state, data)
+        state, loss = train_step(state, data)
     jax.block_until_ready(loss)
 
     steps = 10
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, loss, _ = train_step(state, data)
+        state, loss = train_step(state, data)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 

@@ -1,6 +1,6 @@
 """Gluon — the imperative/hybrid neural-network API (reference
 ``python/mxnet/gluon/``)."""
-from .block import Block, HybridBlock
+from .block import Block, HybridBlock, SymbolBlock
 from .parameter import (Constant, DeferredInitializationError, Parameter,
                         ParameterDict)
 from .trainer import Trainer

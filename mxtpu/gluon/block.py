@@ -30,7 +30,7 @@ from ..ndarray import NDArray
 from ..ndarray import random as _random
 from .parameter import (DeferredInitializationError, Parameter, ParameterDict)
 
-__all__ = ["Block", "HybridBlock"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
 # ---------------------------------------------------------------------------
@@ -124,10 +124,15 @@ def _unflatten_nds(tree, flat: List[Any], pos: List[int]):
 
 
 _TRACE_DEPTH = threading.local()
+_SYM_MODE = threading.local()
 
 
 def _in_trace() -> bool:
     return getattr(_TRACE_DEPTH, "depth", 0) > 0
+
+
+def _in_symbolic() -> bool:
+    return getattr(_SYM_MODE, "active", False)
 
 
 # ---------------------------------------------------------------------------
@@ -250,10 +255,9 @@ class Block:
                         allow_missing: bool = False,
                         ignore_extra: bool = False,
                         cast_dtype: bool = False) -> None:
-        loaded = nd.load(filename)
-        # strip the arg:/aux: markers of the legacy save format
-        loaded = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
-                  for k, v in loaded.items()}
+        from ..model import split_arg_aux
+        arg_p, aux_p = split_arg_aux(nd.load(filename))
+        loaded = {**arg_p, **aux_p}
         params = self._collect_params_with_prefix()
         if not allow_missing:
             missing = [k for k in params if k not in loaded]
@@ -338,6 +342,8 @@ class HybridBlock(Block):
 
     # -- cached (jitted) path -----------------------------------------------
     def __call__(self, *args):
+        if _in_symbolic():
+            return self._symbolic_call(*args)
         if self._active and not _in_trace():
             return self._call_cached_op(*args)
         return super().__call__(*args)
@@ -422,11 +428,171 @@ class HybridBlock(Block):
 
         return jax.jit(raw)
 
-    # -- deploy -------------------------------------------------------------
-    def export(self, path: str, epoch: int = 0) -> None:
-        """Save params in the reference's export layout
-        (``prefix-%04d.params``); graph JSON comes from mxtpu.symbol."""
+    # -- symbolic tracing / deploy ------------------------------------------
+    def _symbolic_call(self, *args):
+        """Trace this block with Symbol inputs → Symbol outputs (the
+        reference's _build_cache trace of hybrid_forward with Symbol
+        placeholders, python/mxnet/gluon/block.py)."""
+        import mxtpu.symbol as sym
+        # non-differentiable state (grad_req='null') must export as an aux
+        # var regardless of its name, so SymbolBlock.imports reconstructs
+        # it as frozen state
+        param_syms = {k: sym.var(p.name, aux=p.grad_req == "null")
+                      for k, p in self._reg_params.items()}
+        return self.hybrid_forward(sym, *args, **param_syms)
+
+    def _trace_symbol(self, *input_syms):
+        """Run the whole net symbolically. Any initialized HybridBlock
+        works — children are traced through __call__ via the thread-local
+        symbolic mode."""
+        prev = getattr(_SYM_MODE, "active", False)
+        _SYM_MODE.active = True
+        try:
+            out = self(*input_syms)
+        finally:
+            _SYM_MODE.active = prev
+        return out
+
+    def export(self, path: str, epoch: int = 0, num_inputs: int = 1) -> None:
+        """Save the traced graph + params in the reference's export layout
+        (``prefix-symbol.json`` + ``prefix-%04d.params``, reference
+        HybridBlock.export) so SymbolBlock.imports / the C predict path
+        can reload it without the Python class. Multi-input nets pass
+        ``num_inputs`` (vars are named data0, data1, ...)."""
+        import mxtpu.symbol as sym
+        n_in = num_inputs
+        inputs = [sym.var("data" if n_in == 1 else f"data{i}")
+                  for i in range(n_in)]
+        out = self._trace_symbol(*inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym.Group(list(out))
+        out.save(f"{path}-symbol.json")
+        aux_names = set(out.list_auxiliary_states())
         params = {}
-        for name, p in self._collect_params_with_prefix().items():
-            params["arg:" + name] = p.data()
+        for p in self.collect_params().values():
+            kind = "aux:" if p.name in aux_names else "arg:"
+            params[kind + p.name] = p.data()
         nd.save(f"{path}-{epoch:04d}.params", params)
+
+
+# ---------------------------------------------------------------------------
+# SymbolBlock
+# ---------------------------------------------------------------------------
+class SymbolBlock(HybridBlock):
+    """Run a Symbol graph as a Gluon block (reference ``gluon.SymbolBlock``)
+    — the reload path for ``HybridBlock.export`` artifacts.
+
+    Parameters are created from the symbol's argument/aux lists (minus the
+    declared inputs); shapes resolve from the params file or lazily from
+    the first forward's input shapes via abstract evaluation.
+    """
+
+    def __init__(self, outputs, inputs, params=None, prefix=None):
+        super().__init__(prefix=prefix or "", params=None)
+        import mxtpu.symbol as sym
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym.Group(list(outputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sb_symbol = outputs
+        self._input_names = [i.name if isinstance(i, sym.Symbol) else str(i)
+                             for i in inputs]
+        aux_names = set(outputs.list_auxiliary_states())
+        self._sb_params: Dict[str, Parameter] = {}
+        loaded = params or {}
+        for name in outputs.list_inputs():
+            if name in self._input_names:
+                continue
+            p = Parameter(name,
+                          grad_req="null" if name in aux_names else "write",
+                          shape=None, allow_deferred_init=True,
+                          differentiable=name not in aux_names)
+            if name in loaded:
+                p._load_init(loaded[name], None)
+            self._sb_params[name] = p
+            self._reg_params[name] = p
+
+    @classmethod
+    def imports(cls, symbol_file: str, input_names, param_file=None,
+                ctx=None) -> "SymbolBlock":
+        """Load an exported prefix-symbol.json (+ params) — reference
+        ``SymbolBlock.imports``."""
+        import mxtpu.symbol as sym
+        out = sym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        params = {}
+        if param_file:
+            from ..model import split_arg_aux
+            arg_p, aux_p = split_arg_aux(nd.load(param_file))
+            params = {**arg_p, **aux_p}
+        inputs = [sym.var(n) for n in input_names]
+        block = cls(out, inputs, params=params)
+        if ctx is not None:
+            block.collect_params().reset_ctx(ctx) \
+                if hasattr(block.collect_params(), "reset_ctx") else None
+        return block
+
+    def _resolve_shapes(self, *args) -> None:
+        import jax as _jax
+        shapes = {n: _jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for n, a in zip(self._input_names, args)
+                  if isinstance(a, NDArray)}
+        for n, p in self._sb_params.items():
+            if p.shape is not None and 0 not in p.shape:
+                shapes[n] = _jax.ShapeDtypeStruct(p.shape, p.dtype)
+        structs = self._sb_symbol._infer_structs(**shapes)
+        if structs is None:
+            raise MXNetError("SymbolBlock: cannot infer parameter shapes "
+                             "from input shapes")
+        _, var_structs = structs
+        for n, p in self._sb_params.items():
+            if p.shape is None or 0 in (p.shape or (0,)):
+                p.shape = tuple(var_structs[n].shape)
+
+    def forward(self, *args):
+        from mxtpu.symbol.symbol import interpret_nd
+        unresolved = [p for p in self._sb_params.values()
+                      if p.shape is None or (p.shape and 0 in p.shape)]
+        if unresolved and any(p._data is None for p in unresolved):
+            self._resolve_shapes(*args)
+            for p in self._sb_params.values():
+                if p._data is None and p._deferred_init:
+                    p._finish_deferred_init()
+        values = dict(zip(self._input_names, args))
+        for n, p in self._sb_params.items():
+            values[n] = p.data()
+        outs, aux_up = interpret_nd(self._sb_symbol._entries, values)
+        if aux_up:
+            with autograd.pause():
+                for n, v in aux_up.items():
+                    self._sb_params[n].set_data(v)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def _symbolic_call(self, *args):
+        # re-exporting a SymbolBlock: splice the stored graph
+        import mxtpu.symbol as sym
+        mapping = dict(zip(self._input_names, args))
+        return _splice_symbol(self._sb_symbol, mapping)
+
+
+def _splice_symbol(symbol, input_map):
+    """Rebuild a symbol graph substituting input vars (for re-export)."""
+    import mxtpu.symbol as sym
+    from mxtpu.symbol.symbol import _Node, Symbol
+    memo = {}
+
+    def clone(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.op == "null" and node.name in input_map:
+            repl = input_map[node.name]._entries[0][0]
+            memo[id(node)] = repl
+            return repl
+        new = _Node(node.op, node.name, dict(node.attrs),
+                    [(clone(p), i) for p, i in node.inputs])
+        memo[id(node)] = new
+        return new
+
+    entries = [(clone(n), i) for n, i in symbol._entries]
+    return Symbol(entries)

@@ -1,0 +1,56 @@
+"""Checkpoint helpers (reference ``python/mxnet/model.py`` [path cite]).
+
+The reference's Module-era checkpoint layout: ``prefix-symbol.json`` (NNVM
+graph JSON) + ``prefix-%04d.params`` (NDArray container with ``arg:``/
+``aux:``-prefixed names). Kept byte-compatible here so artifacts
+interchange with reference tooling.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "split_arg_aux"]
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray]) -> None:
+    """Save symbol + params (reference ``mx.model.save_checkpoint``)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    data = {f"arg:{k}": v for k, v in arg_params.items()}
+    data.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", data)
+
+
+def split_arg_aux(loaded: Dict[str, NDArray]) -> Tuple[Dict[str, NDArray],
+                                                       Dict[str, NDArray]]:
+    """Split an ``arg:``/``aux:``-prefixed name→array dict (the single
+    parser for the checkpoint container naming — also used by
+    SymbolBlock.imports and Block.load_parameters)."""
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_params(prefix: str, epoch: int) -> Tuple[Dict[str, NDArray],
+                                                  Dict[str, NDArray]]:
+    return split_arg_aux(nd.load(f"{prefix}-{epoch:04d}.params"))
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Returns (symbol, arg_params, aux_params) — reference
+    ``mx.model.load_checkpoint``."""
+    from . import symbol as sym
+    symbol = sym.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

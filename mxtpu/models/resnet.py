@@ -241,14 +241,14 @@ def forward(cfg: ResNetConfig, params, x, state=None, train: bool = False):
     return logits, new_state
 
 
-def loss_fn(cfg: ResNetConfig, state=None):
-    """Softmax cross-entropy over {'image','label'} batches; returns
-    (loss, new_bn_state) — use ``loss_has_aux=True`` in
-    ``make_train_step``."""
-    if state is None:
-        state = init_state(cfg)
+def loss_fn(cfg: ResNetConfig):
+    """Softmax cross-entropy over {'image','label'} batches. Signature
+    ``loss(params, bn_state, batch) -> (loss, new_bn_state)`` — use
+    ``has_state=True`` in ``make_train_step`` so running BN stats
+    accumulate across steps (init via
+    ``init_state(..., model_state=resnet.init_state(cfg))``)."""
 
-    def loss(params, batch):
+    def loss(params, state, batch):
         logits, new_state = forward(cfg, params, batch["image"], state,
                                     train=True)
         logp = jax.nn.log_softmax(logits, axis=-1)

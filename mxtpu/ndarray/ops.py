@@ -954,6 +954,43 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     return apply_op(_f, arrs, "ctc_loss")
 
 
+# -- scalar ops (reference _plus_scalar etc., the internal names the Symbol
+# frontend and graph JSON use for array∘scalar arithmetic:
+# src/operator/tensor/elemwise_binary_scalar_op_basic.cc) ---------------------
+def _scalar_op(name, raw, rev=False):
+    @register_op(name)
+    def op(data, scalar=0.0, **kwargs):
+        if rev:
+            return apply_op(lambda x: raw(scalar, x), [data], name)
+        return apply_op(lambda x: raw(x, scalar), [data], name)
+    op.__name__ = name
+    return op
+
+
+_scalar_op("_plus_scalar", jnp.add)
+_scalar_op("_minus_scalar", jnp.subtract)
+_scalar_op("_rminus_scalar", jnp.subtract, rev=True)
+_scalar_op("_mul_scalar", jnp.multiply)
+_scalar_op("_div_scalar", jnp.divide)
+_scalar_op("_rdiv_scalar", jnp.divide, rev=True)
+_scalar_op("_mod_scalar", jnp.mod)
+_scalar_op("_rmod_scalar", jnp.mod, rev=True)
+_scalar_op("_power_scalar", jnp.power)
+_scalar_op("_rpower_scalar", jnp.power, rev=True)
+_scalar_op("_maximum_scalar", jnp.maximum)
+_scalar_op("_minimum_scalar", jnp.minimum)
+for _nm, _raw in [("_equal_scalar", jnp.equal),
+                  ("_not_equal_scalar", jnp.not_equal),
+                  ("_greater_scalar", jnp.greater),
+                  ("_greater_equal_scalar", jnp.greater_equal),
+                  ("_lesser_scalar", jnp.less),
+                  ("_lesser_equal_scalar", jnp.less_equal)]:
+    _scalar_op(_nm, (lambda r: lambda a, b: r(a, b).astype(
+        a.dtype if hasattr(a, "dtype") and a.dtype != jnp.bool_
+        else jnp.float32))(_raw))
+_scalar_op("_hypot_scalar", jnp.hypot)
+
+
 # -- misc -------------------------------------------------------------------
 @register_op("add_n", aliases=("ElementWiseSum",))
 def add_n(*args, **kwargs):

@@ -27,15 +27,18 @@ __all__ = ["TrainState", "init_state", "make_train_step", "make_eval_step"]
 
 
 class TrainState(NamedTuple):
-    """Functional training state (params + optimizer state + step)."""
+    """Functional training state (params + optimizer state + step +
+    non-differentiable model state, e.g. BatchNorm running stats — the
+    reference's mutable aux params, threaded functionally)."""
     params: Any
     opt_state: Any
     step: Any
+    model_state: Any = ()
 
     @classmethod
-    def create(cls, params: Any, tx) -> "TrainState":
+    def create(cls, params: Any, tx, model_state: Any = ()) -> "TrainState":
         return cls(params=params, opt_state=tx.init(params),
-                   step=jnp.zeros((), jnp.int32))
+                   step=jnp.zeros((), jnp.int32), model_state=model_state)
 
 
 def _path_str(path) -> tuple:
@@ -71,10 +74,11 @@ def opt_state_shardings(tx, params: Any, mesh: Mesh,
 
 
 def init_state(params: Any, tx, mesh: Mesh,
-               rules: ShardingRules) -> TrainState:
+               rules: ShardingRules, model_state: Any = ()) -> TrainState:
     """Place params per the rule table and build the optimizer state
     sharded to match (per-param moments inherit their parameter's
-    sharding; scalars replicate)."""
+    sharding; scalars replicate). ``model_state`` (BN running stats etc.)
+    is placed by the same rule table — typically replicated."""
     pspecs = rules.tree_specs(params)
     # copy ON the target sharding: the train step donates the state (so
     # the caller's arrays must never be aliased), and the copy must not
@@ -88,57 +92,79 @@ def init_state(params: Any, tx, mesh: Mesh,
     opt_state = jax.jit(tx.init, out_shardings=oshard)(params)
     step = jax.device_put(jnp.zeros((), jnp.int32),
                           NamedSharding(mesh, P()))
-    return TrainState(params, opt_state, step)
+    if model_state != ():
+        msharding = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), rules.tree_specs(model_state),
+            is_leaf=lambda s: isinstance(s, P))
+        model_state = jax.jit(lambda t: jax.tree.map(jnp.copy, t),
+                              out_shardings=msharding)(model_state)
+    return TrainState(params, opt_state, step, model_state)
 
 
 def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
                     rules: Optional[ShardingRules] = None,
                     has_rng: bool = False,
                     grad_accum: int = 1,
-                    loss_has_aux: bool = False):
+                    loss_has_aux: bool = False,
+                    has_state: bool = False):
     """Build the jitted sharded step.
 
     ``loss_fn(params, batch[, rng]) -> loss`` (or ``(loss, aux)`` with
-    ``loss_has_aux``). ``tx`` is an optax GradientTransformation.
-    Returns ``step(state, batch[, rng]) -> (state, loss[, aux])``;
-    ``state`` is donated.
+    ``loss_has_aux``). With ``has_state``, ``loss_fn(params, model_state,
+    batch[, rng]) -> (loss, new_model_state)`` and the state threads
+    through ``TrainState.model_state`` across steps (BatchNorm running
+    stats — the reference's aux params). ``tx`` is an optax
+    GradientTransformation. Returns ``step(state, batch[, rng]) ->
+    (state, loss[, aux])``; ``state`` is donated.
     """
+    if has_state and loss_has_aux:
+        raise ValueError("has_state already uses the aux slot for "
+                         "model_state; fold extra aux into it")
     rules = rules or ShardingRules([(r".*", P())])
     # with accumulation the leading batch dim is the microbatch index
     # (scanned over); the dp sharding moves to dim 1
-    bspec = (P(None, ("dp", "fsdp")) if grad_accum > 1
+    bspec = (P(None, *batch_spec(mesh)) if grad_accum > 1
              else batch_spec(mesh))
     bsharding = NamedSharding(mesh, bspec)
+    has_aux = loss_has_aux or has_state
 
-    def _loss(params, batch, rng):
-        out = loss_fn(params, batch, rng) if has_rng else loss_fn(params, batch)
-        return out
+    def _loss(params, batch, rng, mstate):
+        if has_state:
+            return loss_fn(params, mstate, batch, rng) if has_rng \
+                else loss_fn(params, mstate, batch)
+        return loss_fn(params, batch, rng) if has_rng \
+            else loss_fn(params, batch)
 
-    grad_fn = jax.value_and_grad(_loss, has_aux=loss_has_aux)
+    grad_fn = jax.value_and_grad(_loss, has_aux=has_aux)
 
     def _step(state: TrainState, batch, rng):
+        mstate = state.model_state
         if grad_accum > 1:
             def body(carry, xs):
                 i, mb = xs
-                loss_acc, grad_acc = carry
+                loss_acc, grad_acc, ms = carry
                 # distinct dropout/noise per microbatch, else accumulation
                 # is not equivalent to the large batch
                 mb_rng = None if rng is None else jax.random.fold_in(rng, i)
-                val, grads = grad_fn(state.params, mb, mb_rng)
-                loss = val[0] if loss_has_aux else val
-                aux = val[1] if loss_has_aux else 0.0
+                val, grads = grad_fn(state.params, mb, mb_rng, ms)
+                loss = val[0] if has_aux else val
+                aux = val[1] if has_aux else 0.0
+                if has_state:
+                    ms, aux = aux, 0.0
                 return (loss_acc + loss,
-                        jax.tree.map(jnp.add, grad_acc, grads)), aux
+                        jax.tree.map(jnp.add, grad_acc, grads), ms), aux
             zeros = jax.tree.map(jnp.zeros_like, state.params)
-            (loss, grads), auxes = jax.lax.scan(
-                body, (jnp.zeros(()), zeros),
+            (loss, grads, mstate), auxes = jax.lax.scan(
+                body, (jnp.zeros(()), zeros, mstate),
                 (jnp.arange(grad_accum), batch))
             loss = loss / grad_accum
             grads = jax.tree.map(lambda g: g / grad_accum, grads)
             aux = auxes  # per-microbatch aux, stacked on the leading dim
         else:
-            val, grads = grad_fn(state.params, batch, rng)
-            loss, aux = (val if loss_has_aux else (val, None))
+            val, grads = grad_fn(state.params, batch, rng, mstate)
+            loss, aux = (val if has_aux else (val, None))
+            if has_state:
+                mstate, aux = aux, None
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                               state.params, updates)
@@ -149,7 +175,7 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
             params, jax.tree.map(lambda s: NamedSharding(mesh, s),
                                  rules.tree_specs(params),
                                  is_leaf=lambda s: isinstance(s, P)))
-        new = TrainState(params, opt_state, state.step + 1)
+        new = TrainState(params, opt_state, state.step + 1, mstate)
         if loss_has_aux:
             return new, loss, aux
         return new, loss

@@ -1,0 +1,45 @@
+"""mx.sym — symbolic graph API (reference ``python/mxnet/symbol/``).
+
+Every op registered in the shared OP_REGISTRY (mxtpu/ndarray/ops.py) is
+available here as a graph-composing function, mirroring the reference's
+code-generated ``mx.sym.*`` wrappers (python/mxnet/symbol/register.py).
+"""
+from ..ndarray import ops as _ops
+from .symbol import (Symbol, var, Variable, Group, load, load_json,
+                     Executor, make_symbol_function)
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "Executor", "zeros", "ones"]
+
+_FN_CACHE = {}
+
+
+def __getattr__(name):
+    if name in _ops.OP_REGISTRY:
+        fn = _FN_CACHE.get(name)
+        if fn is None:
+            fn = make_symbol_function(name)
+            _FN_CACHE[name] = fn
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'mxtpu.symbol' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_ops.OP_REGISTRY)))
+
+
+def _full(shape, val, dtype, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return __getattr__("full")(shape=tuple(shape), val=val, dtype=dtype,
+                               **kwargs)
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    """Constant-zero symbol (reference mx.sym.zeros)."""
+    return _full(shape, 0.0, dtype, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _full(shape, 1.0, dtype, **kwargs)

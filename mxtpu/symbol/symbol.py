@@ -1,0 +1,990 @@
+"""Symbol — the symbolic graph frontend (reference
+``python/mxnet/symbol/symbol.py`` + NNVM graph IR
+``3rdparty/tvm/nnvm/include/nnvm`` [path cites — unverified]).
+
+The reference composes immutable NNVM nodes and binds them through
+``GraphExecutor`` (src/executor/graph_executor.cc); the rebuild keeps the
+same user surface (``var``/op composition/``infer_shape``/``tojson``/
+``simple_bind``) but the "executor" is one jitted XLA program per
+(is_train,) mode — graph passes (shape inference, memory planning, op
+fusion) are XLA's job.
+
+Implementation: a Symbol is a list of output entries ``(node, out_idx)``
+over a DAG of ``_Node``s; each node names an op in
+:data:`mxtpu.ndarray.ops.OP_REGISTRY` (the same kernels the imperative API
+uses — one op library, two frontends, exactly like the reference's shared
+FCompute registry).
+"""
+from __future__ import annotations
+
+import ast
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import autograd
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from ..ndarray import ops as _ops
+from ..ndarray import random as _random
+from ..ndarray import zeros as nd_zeros
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "Executor"]
+
+
+# ---------------------------------------------------------------------------
+# op metadata: which call args are array inputs (in order), which are aux
+# ---------------------------------------------------------------------------
+_OP_ARRAY_ARGS: Dict[str, Tuple[str, ...]] = {
+    "FullyConnected": ("data", "weight", "bias"),
+    "Convolution": ("data", "weight", "bias"),
+    "Deconvolution": ("data", "weight", "bias"),
+    "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "LayerNorm": ("data", "gamma", "beta"),
+    "InstanceNorm": ("data", "gamma", "beta"),
+    "GroupNorm": ("data", "gamma", "beta"),
+    "Embedding": ("data", "weight"),
+    "LeakyReLU": ("data", "gamma"),
+    "RNN": ("data", "parameters", "state", "state_cell"),
+    "SoftmaxOutput": ("data", "label"),
+    "softmax_cross_entropy": ("data", "label"),
+    "where": ("condition", "x", "y"),
+    "ctc_loss": ("data", "label", "data_lengths", "label_lengths"),
+}
+for _alias, _canon in [("fully_connected", "FullyConnected"),
+                       ("convolution", "Convolution"),
+                       ("deconvolution", "Deconvolution"),
+                       ("batch_norm", "BatchNorm"),
+                       ("layer_norm", "LayerNorm"),
+                       ("embedding", "Embedding")]:
+    _OP_ARRAY_ARGS[_alias] = _OP_ARRAY_ARGS[_canon]
+
+_OP_AUX_ARGS = {"BatchNorm": ("moving_mean", "moving_var"),
+                "batch_norm": ("moving_mean", "moving_var")}
+
+# ops whose trailing optional array args are skipped under these attrs
+_VARIADIC_OPS = {"concat", "Concat", "add_n", "ElementWiseSum", "stack"}
+
+
+def _num_outputs(op: str, attrs: Dict[str, Any]) -> int:
+    if op in ("split", "SliceChannel"):
+        return int(attrs.get("num_outputs", 1))
+    if op == "topk" and attrs.get("ret_typ") == "both":
+        return 2
+    return 1
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs")
+
+    def __init__(self, op: str, name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]]):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.num_outputs = _num_outputs(op, attrs) if op != "null" else 1
+
+    def is_var(self) -> bool:
+        return self.op == "null"
+
+    def is_aux(self) -> bool:
+        return self.op == "null" and (
+            self.attrs.get("__aux__") or
+            self.name.endswith(("moving_mean", "moving_var",
+                                "running_mean", "running_var")))
+
+
+_NAME_COUNTER: Dict[str, int] = {}
+
+
+def _auto_name(op: str) -> str:
+    hint = op.lower().lstrip("_")
+    n = _NAME_COUNTER.get(hint, 0)
+    _NAME_COUNTER[hint] = n + 1
+    return f"{hint}{n}"
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+class Symbol:
+    """A (possibly multi-output) handle into the symbolic graph."""
+
+    def __init__(self, entries: List[Tuple[_Node, int]]):
+        self._entries = entries
+
+    # -- construction helpers -----------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return "group"
+
+    def __repr__(self):
+        outs = ", ".join(f"{n.name}[{i}]" if n.num_outputs and
+                         n.num_outputs > 1 else n.name
+                         for n, i in self._entries)
+        return f"<Symbol {outs}>"
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for n, i in self._entries:
+                if n.name == index:
+                    return Symbol([(n, i)])
+            raise ValueError(f"no output named {index!r}")
+        if len(self._entries) == 1:
+            node, _ = self._entries[0]
+            if node.num_outputs is not None and node.num_outputs > 1:
+                if index >= node.num_outputs:
+                    raise IndexError(index)
+                return Symbol([(node, index)])
+        return Symbol([self._entries[index]])
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    def __iter__(self):
+        n = len(self.list_outputs())
+        return (self[i] for i in range(n))
+
+    def attr(self, key: str):
+        if len(self._entries) == 1:
+            v = self._entries[0][0].attrs.get(key)
+            return None if v is None else str(v)
+        return None
+
+    def list_attr(self) -> Dict[str, str]:
+        if len(self._entries) == 1:
+            return {k: str(v) for k, v in self._entries[0][0].attrs.items()}
+        return {}
+
+    def get_internals(self) -> "Symbol":
+        """Symbol exposing every node's outputs (reference
+        ``Symbol.get_internals``), selectable as ``internals['name_output']``."""
+        entries = []
+        for node in self._topo():
+            for i in range(node.num_outputs or 1):
+                entries.append((node, i))
+        return _InternalsSymbol(entries)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node, _ = self._entries[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- graph queries -------------------------------------------------------
+    def _topo(self) -> List[_Node]:
+        order: List[_Node] = []
+        seen = set()
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for parent, _ in node.inputs:
+                visit(parent)
+            order.append(node)
+
+        for node, _ in self._entries:
+            visit(node)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_var() and not n.is_aux()]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_aux()]
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_var()]
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, i in self._entries:
+            if node.num_outputs and node.num_outputs > 1:
+                outs.append(f"{node.name}_output{i}")
+            else:
+                outs.append(node.name + "_output" if not node.is_var()
+                            else node.name)
+        return outs
+
+    # -- composition: arithmetic --------------------------------------------
+    def _binop(self, other, op, scalar_op, rev: bool = False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if rev else (self, other)
+            return _make_op_symbol(op, [a, b], {})
+        return _make_op_symbol(scalar_op, [self], {"scalar": float(other)})
+
+    def __add__(self, o): return self._binop(o, "broadcast_add", "_plus_scalar")
+    def __radd__(self, o): return self.__add__(o)
+    def __sub__(self, o): return self._binop(o, "broadcast_sub", "_minus_scalar")
+    def __rsub__(self, o): return self._binop(o, "broadcast_sub", "_rminus_scalar", rev=True)
+    def __mul__(self, o): return self._binop(o, "broadcast_mul", "_mul_scalar")
+    def __rmul__(self, o): return self.__mul__(o)
+    def __truediv__(self, o): return self._binop(o, "broadcast_div", "_div_scalar")
+    def __rtruediv__(self, o): return self._binop(o, "broadcast_div", "_rdiv_scalar", rev=True)
+    def __mod__(self, o): return self._binop(o, "broadcast_mod", "_mod_scalar")
+    def __pow__(self, o): return self._binop(o, "broadcast_power", "_power_scalar")
+    def __rpow__(self, o): return self._binop(o, "broadcast_power", "_rpower_scalar", rev=True)
+    def __neg__(self): return _make_op_symbol("negative", [self], {})
+
+    def __eq__(self, o): return self._binop(o, "broadcast_equal", "_equal_scalar")
+    def __ne__(self, o): return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+    def __gt__(self, o): return self._binop(o, "broadcast_greater", "_greater_scalar")
+    def __ge__(self, o): return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+    def __lt__(self, o): return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+    def __le__(self, o): return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # -- composition: common methods (mirror NDArray) ------------------------
+    def reshape(self, shape, **kw):
+        return _make_op_symbol("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return _make_op_symbol("transpose", [self],
+                               {} if axes is None else {"axes": tuple(axes)})
+
+    def flatten(self):
+        return _make_op_symbol("Flatten", [self], {})
+
+    def sum(self, axis=None, keepdims=False):
+        return _make_op_symbol("sum", [self],
+                               {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _make_op_symbol("mean", [self],
+                               {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _make_op_symbol("max", [self],
+                               {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _make_op_symbol("min", [self],
+                               {"axis": axis, "keepdims": keepdims})
+
+    def astype(self, dtype):
+        return _make_op_symbol("cast", [self], {"dtype": str(_np.dtype(dtype_np(dtype)))})
+
+    def slice_axis(self, axis, begin, end):
+        return _make_op_symbol("slice_axis", [self],
+                               {"axis": axis, "begin": begin, "end": end})
+
+    def expand_dims(self, axis):
+        return _make_op_symbol("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _make_op_symbol("squeeze", [self], {"axis": axis})
+
+    def softmax(self, axis=-1):
+        return _make_op_symbol("softmax", [self], {"axis": axis})
+
+    def relu(self):
+        return _make_op_symbol("relu", [self], {})
+
+    def sigmoid(self):
+        return _make_op_symbol("sigmoid", [self], {})
+
+    def tanh(self):
+        return _make_op_symbol("tanh", [self], {})
+
+    def exp(self):
+        return _make_op_symbol("exp", [self], {})
+
+    def log(self):
+        return _make_op_symbol("log", [self], {})
+
+    def sqrt(self):
+        return _make_op_symbol("sqrt", [self], {})
+
+    def abs(self):
+        return _make_op_symbol("abs", [self], {})
+
+    def dot(self, other):
+        return _make_op_symbol("dot", [self, other], {})
+
+    def __getattr__(self, name):
+        # any registered op becomes a method: sym.broadcast_like(...), etc.
+        if not name.startswith("_") and name in _ops.OP_REGISTRY:
+            def method(*args, **kwargs):
+                import mxtpu.symbol as _sym_mod
+                return getattr(_sym_mod, name)(self, *args, **kwargs)
+            return method
+        raise AttributeError(f"Symbol has no attribute {name!r}")
+
+    # -- shape/type inference ------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes) in declaration order.
+
+        The reference runs the NNVM InferShape pass; here we resolve
+        parameter shapes per-op (forward) and abstract-eval each node with
+        ``jax.eval_shape`` — no kernels run.
+        """
+        structs = self._infer_structs(*args, **kwargs)
+        if structs is None:
+            return None, None, None
+        entry_structs, var_structs = structs
+        arg_shapes = [tuple(var_structs[n].shape)
+                      for n in self.list_arguments()]
+        aux_shapes = [tuple(var_structs[n].shape)
+                      for n in self.list_auxiliary_states()]
+        out_shapes = [tuple(entry_structs[(id(n), i)].shape)
+                      for n, i in self._entries]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        structs = self._infer_structs(**{k: jax.ShapeDtypeStruct((1,), dtype_np(v))
+                                         for k, v in kwargs.items()}) \
+            if all(not isinstance(v, (tuple, list)) for v in kwargs.values()) \
+            else self._infer_structs(*args, **kwargs)
+        if structs is None:
+            return None, None, None
+        entry_structs, var_structs = structs
+        arg_types = [_np.dtype(var_structs[n].dtype)
+                     for n in self.list_arguments()]
+        aux_types = [_np.dtype(var_structs[n].dtype)
+                     for n in self.list_auxiliary_states()]
+        out_types = [_np.dtype(entry_structs[(id(n), i)].dtype)
+                     for n, i in self._entries]
+        return arg_types, out_types, aux_types
+
+    def _infer_structs(self, *args, **kwargs):
+        """Abstract-evaluate the graph. kwargs: name → shape tuple (dtype
+        defaults f32), or name → ShapeDtypeStruct. Positional args match
+        list_arguments order."""
+        if args:
+            for name, a in zip(self.list_arguments(), args):
+                if a is not None:
+                    kwargs.setdefault(name, a)
+        var_structs: Dict[str, jax.ShapeDtypeStruct] = {}
+        for name, spec in kwargs.items():
+            if isinstance(spec, jax.ShapeDtypeStruct):
+                var_structs[name] = spec
+            else:
+                var_structs[name] = jax.ShapeDtypeStruct(
+                    tuple(spec), _np.float32)
+        entry_structs: Dict[Tuple[int, int], jax.ShapeDtypeStruct] = {}
+
+        def var_struct(node: _Node):
+            # a var's shape may only become known once a consuming op's
+            # param rule runs (_resolve_param_shapes) — resolve lazily
+            if node.name not in var_structs:
+                shp = node.attrs.get("__shape__")
+                dt = node.attrs.get("__dtype__", "float32")
+                if shp is None:
+                    return None  # underdetermined
+                var_structs[node.name] = jax.ShapeDtypeStruct(
+                    tuple(shp), dtype_np(dt))
+            st = var_structs[node.name]
+            entry_structs[(id(node), 0)] = st
+            return st
+
+        for node in self._topo():
+            if node.is_var():
+                continue
+            _resolve_param_shapes(node, var_structs, entry_structs)
+            in_structs = []
+            for p, i in node.inputs:
+                st = entry_structs.get((id(p), i))
+                if st is None and p.is_var():
+                    st = var_struct(p)
+                if st is None:
+                    return None  # underdetermined
+                in_structs.append(st)
+            outs = _abstract_eval_node(node, in_structs)
+            for i, o in enumerate(outs):
+                entry_structs[(id(node), i)] = o
+            if node.num_outputs is None:
+                node.num_outputs = len(outs)
+        # entries that are bare vars (identity outputs)
+        for node, _ in self._entries:
+            if node.is_var() and var_struct(node) is None:
+                return None
+        return entry_structs, var_structs
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self) -> str:
+        nodes = self._topo()
+        index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": n.op,
+                "name": n.name,
+                "attrs": {k: _attr_str(v) for k, v in n.attrs.items()},
+                "inputs": [[index[id(p)], i, 0] for p, i in n.inputs],
+            })
+        graph = {
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_var()],
+            "heads": [[index[id(n)], i, 0] for n, i in self._entries],
+            "attrs": {"mxnet_version": ["int", 10900],
+                      "mxtpu": ["int", 1]},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- evaluation ----------------------------------------------------------
+    def eval(self, ctx: Optional[Context] = None, **kwargs) -> List[NDArray]:
+        """Evaluate with NDArray bindings for every argument (reference
+        ``Symbol.eval`` — bind + forward in one call)."""
+        ex = self.bind(ctx or current_context(), kwargs)
+        return ex.forward(is_train=False)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs) -> "Executor":
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self.list_arguments(), args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self.list_arguments(), args_grad))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self.list_auxiliary_states(), aux_states))
+        return Executor(self, ctx, args, args_grad, grad_req,
+                        aux_states or {})
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **shapes) -> "Executor":
+        """Allocate argument/gradient/aux arrays from inferred shapes and
+        bind (reference ``Symbol.simple_bind`` → GraphExecutor::Init)."""
+        ctx = ctx or current_context()
+        structs = self._infer_structs(**shapes)
+        if structs is None:
+            raise MXNetError(
+                f"simple_bind: cannot infer all shapes from {shapes}")
+        _, var_structs = structs
+        type_dict = type_dict or {}
+        args = {}
+        for name in self.list_arguments():
+            st = var_structs[name]
+            dt = dtype_np(type_dict.get(name, st.dtype))
+            args[name] = nd_zeros(st.shape, ctx, dt)
+        aux = {}
+        for name in self.list_auxiliary_states():
+            st = var_structs[name]
+            aux[name] = nd_zeros(st.shape, ctx, st.dtype)
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {n: nd_zeros(v.shape, ctx, v.dtype)
+                         for n, v in args.items()}
+        return Executor(self, ctx, args, args_grad, grad_req, aux)
+
+
+class _InternalsSymbol(Symbol):
+    """get_internals() result: indexable by 'name_output' / 'name'."""
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            want = index[:-7] if index.endswith("_output") else index
+            for n, i in self._entries:
+                if n.name == want:
+                    return Symbol([(n, i)])
+            raise ValueError(f"no internal output {index!r}")
+        return Symbol([self._entries[index]])
+
+
+# ---------------------------------------------------------------------------
+# node construction
+# ---------------------------------------------------------------------------
+def _attr_str(v) -> str:
+    return json.dumps(v) if not isinstance(v, str) else v
+
+
+def _parse_attr(s: str):
+    if not isinstance(s, str):
+        return s
+    try:
+        return json.loads(s)
+    except (ValueError, TypeError):
+        try:
+            return ast.literal_eval(s)
+        except (ValueError, SyntaxError):
+            return s
+
+
+def var(name: str, attr=None, shape=None, dtype=None, lr_mult=None,
+        wd_mult=None, init=None, stype=None, aux=False, **kwargs) -> Symbol:
+    """Create a symbolic variable (reference ``mx.sym.var``)."""
+    attrs: Dict[str, Any] = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype_np(dtype)))
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        attrs["__init__"] = str(init)
+    if aux:
+        attrs["__aux__"] = True
+    node = _Node("null", name, attrs, [])
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    entries: List[Tuple[_Node, int]] = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def _make_op_symbol(op: str, inputs: Sequence[Symbol],
+                    attrs: Dict[str, Any], name: Optional[str] = None) -> Symbol:
+    if op not in _ops.OP_REGISTRY:
+        raise MXNetError(f"unknown op {op!r} in symbolic graph")
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    name = name or _auto_name(op)
+    entries = []
+    for s in inputs:
+        if not isinstance(s, Symbol):
+            raise TypeError(f"op {op}: inputs must be Symbols, got {type(s)}")
+        if len(s._entries) != 1:
+            raise MXNetError(f"op {op}: cannot take a grouped symbol input")
+        entries.append(s._entries[0])
+    node = _Node(op, name, attrs, entries)
+    if node.num_outputs and node.num_outputs > 1:
+        return Symbol([(node, i) for i in range(node.num_outputs)])
+    return Symbol([(node, 0)])
+
+
+def make_symbol_function(op_name: str):
+    """Build the ``mx.sym.<op>`` composer for a registered op."""
+    array_args = _OP_ARRAY_ARGS.get(op_name)
+    aux_args = set(_OP_AUX_ARGS.get(op_name, ()))
+
+    def sym_fn(*args, name: Optional[str] = None, attr=None, **kwargs):
+        inputs: List[Symbol] = []
+        attrs: Dict[str, Any] = dict(attr or {})
+        # variadic ops: all positional Symbols are inputs
+        if op_name in _VARIADIC_OPS:
+            flat = args[0] if len(args) == 1 and \
+                isinstance(args[0], (list, tuple)) else args
+            inputs = list(flat)
+            attrs.update({k: v for k, v in kwargs.items()
+                          if not isinstance(v, Symbol)})
+            return _make_op_symbol(op_name, inputs, attrs, name)
+        if array_args:
+            name = name or _auto_name(op_name)
+            no_bias = bool(kwargs.get("no_bias", False))
+            supplied = dict(zip(array_args, args))
+            for k in list(kwargs):
+                if isinstance(kwargs[k], Symbol):
+                    supplied[k] = kwargs.pop(k)
+            attrs.update(kwargs)
+            for pname in array_args:
+                if pname == "bias" and no_bias:
+                    continue
+                if pname in supplied and supplied[pname] is not None:
+                    inputs.append(supplied[pname])
+                elif pname == "data":
+                    raise MXNetError(f"{op_name}: 'data' input required")
+                elif op_name == "LeakyReLU" and pname == "gamma" and \
+                        attrs.get("act_type", "leaky") != "prelu":
+                    continue
+                elif op_name == "ctc_loss" and pname in (
+                        "data_lengths", "label_lengths"):
+                    continue
+                elif op_name == "RNN" and pname == "state_cell" and \
+                        attrs.get("mode") != "lstm":
+                    continue
+                else:
+                    # auto-create the parameter variable (reference NNVM
+                    # behavior: sym.FullyConnected(data, num_hidden=k)
+                    # materializes fc_weight/fc_bias vars)
+                    inputs.append(var(f"{name}_{pname}",
+                                      aux=pname in aux_args))
+            return _make_op_symbol(op_name, inputs, attrs, name)
+        # generic op: positional Symbols are inputs, everything else attrs
+        rest = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            else:
+                rest.append(a)
+        if rest:
+            # positional non-symbol args keep their declared order after
+            # arrays (e.g. sym.reshape(x, shape)); map by op signature
+            import inspect
+            fn = _ops.OP_REGISTRY[op_name]
+            try:
+                sig = inspect.signature(fn)
+                pnames = [p for p in sig.parameters
+                          if p not in ("args", "kwargs")]
+                extra = pnames[len(inputs):len(inputs) + len(rest)]
+                for k, v in zip(extra, rest):
+                    attrs[k] = v
+            except (ValueError, TypeError):
+                raise MXNetError(
+                    f"{op_name}: cannot map positional args {rest}")
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                inputs.append(v)
+            else:
+                attrs[k] = v
+        return _make_op_symbol(op_name, inputs, attrs, name)
+
+    sym_fn.__name__ = op_name
+    sym_fn.__qualname__ = f"sym.{op_name}"
+    sym_fn.__doc__ = f"Symbolic version of mx.nd.{op_name}."
+    return sym_fn
+
+
+# ---------------------------------------------------------------------------
+# JSON load
+# ---------------------------------------------------------------------------
+def load_json(json_str: str) -> Symbol:
+    graph = json.loads(json_str)
+    jnodes = graph["nodes"]
+    nodes: List[_Node] = []
+    for jn in jnodes:
+        attrs = {k: _parse_attr(v)
+                 for k, v in (jn.get("attrs") or jn.get("param") or {}).items()}
+        inputs = [(nodes[i], oi) for i, oi, *_ in jn["inputs"]]
+        nodes.append(_Node(jn["op"], jn["name"], attrs, inputs))
+    heads = graph.get("heads") or [[len(nodes) - 1, 0, 0]]
+    return Symbol([(nodes[i], oi) for i, oi, *_ in heads])
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# interpretation (shared by Executor / eval / abstract eval)
+# ---------------------------------------------------------------------------
+def _call_registry_op(node: _Node, in_nds: List[NDArray]):
+    fn = _ops.OP_REGISTRY[node.op]
+    attrs = {k: v for k, v in node.attrs.items()
+             if not k.startswith("__")}
+    out = fn(*in_nds, **attrs)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _abstract_eval_node(node: _Node, in_structs):
+    def f(*raw):
+        with autograd.pause():
+            nds = [NDArray(r) for r in raw]
+            outs = _call_registry_op(node, nds)
+            return tuple(o._data for o in outs)
+    try:
+        return jax.eval_shape(f, *in_structs)
+    except Exception as e:
+        raise MXNetError(
+            f"shape inference failed at op {node.op}({node.name}) with "
+            f"input shapes {[tuple(s.shape) for s in in_structs]}: {e}") from e
+
+
+# forward param-shape rules: resolve unknown var shapes feeding an op from
+# its data input shape + attrs (the reference gets this from each op's
+# FInferShape; these mirror gluon's per-layer infer_shape rules)
+def _resolve_param_shapes(node: _Node, var_structs, entry_structs) -> None:
+    unresolved = [(idx, p) for idx, (p, _) in enumerate(node.inputs)
+                  if p.is_var() and p.name not in var_structs and
+                  "__shape__" not in p.attrs]
+    if not unresolved:
+        return
+    op = node.op
+    array_args = _OP_ARRAY_ARGS.get(op)
+    if array_args is None:
+        return
+    d_entry = node.inputs[0]
+    dstruct = entry_structs.get((id(d_entry[0]), d_entry[1]))
+    if dstruct is None and d_entry[0].is_var():
+        dstruct = var_structs.get(d_entry[0].name)
+        if dstruct is None and "__shape__" in d_entry[0].attrs:
+            dstruct = jax.ShapeDtypeStruct(
+                tuple(d_entry[0].attrs["__shape__"]),
+                dtype_np(d_entry[0].attrs.get("__dtype__", "float32")))
+    if dstruct is None:
+        return
+    dshape = tuple(dstruct.shape)
+    a = node.attrs
+    # which array arg does each input slot hold? (bias may be skipped)
+    slot_names = []
+    ai = 0
+    for p, _ in node.inputs:
+        if ai < len(array_args):
+            nm = array_args[ai]
+            if nm == "bias" and a.get("no_bias"):
+                ai += 1
+                nm = array_args[ai] if ai < len(array_args) else "?"
+            slot_names.append(nm)
+            ai += 1
+        else:
+            slot_names.append("?")
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    if op in ("FullyConnected", "fully_connected"):
+        nh = int(a["num_hidden"])
+        in_units = int(_np.prod(dshape[1:])) if a.get("flatten", True) \
+            else dshape[-1]
+        shapes = {"weight": (nh, in_units), "bias": (nh,)}
+    elif op in ("Convolution", "convolution"):
+        nf = int(a["num_filter"])
+        kernel = tuple(a["kernel"])
+        ng = int(a.get("num_group", 1))
+        shapes = {"weight": (nf, dshape[1] // ng) + kernel, "bias": (nf,)}
+    elif op in ("Deconvolution", "deconvolution"):
+        nf = int(a["num_filter"])
+        kernel = tuple(a["kernel"])
+        ng = int(a.get("num_group", 1))
+        shapes = {"weight": (dshape[1], nf // ng) + kernel, "bias": (nf,)}
+    elif op in ("BatchNorm", "batch_norm", "InstanceNorm", "GroupNorm"):
+        axis = int(a.get("axis", 1)) % len(dshape)
+        c = dshape[axis]
+        shapes = {k: (c,) for k in
+                  ("gamma", "beta", "moving_mean", "moving_var")}
+    elif op in ("LayerNorm", "layer_norm"):
+        axis = int(a.get("axis", -1)) % len(dshape)
+        c = dshape[axis]
+        shapes = {"gamma": (c,), "beta": (c,)}
+    elif op in ("Embedding", "embedding"):
+        shapes = {"weight": (int(a["input_dim"]), int(a["output_dim"]))}
+    elif op == "LeakyReLU":
+        shapes = {"gamma": (dshape[1] if len(dshape) > 1 else dshape[0],)}
+    for idx, p in unresolved:
+        nm = slot_names[idx] if idx < len(slot_names) else "?"
+        if nm in shapes:
+            var_structs[p.name] = jax.ShapeDtypeStruct(
+                shapes[nm], dstruct.dtype)
+
+
+def interpret_nd(entries: List[Tuple[_Node, int]],
+                 values: Dict[str, NDArray]):
+    """Run the graph on NDArrays through the registry ops (tape-aware:
+    under autograd.record this records exactly like imperative calls).
+
+    Returns (outputs, aux_updates) — BatchNorm running-stat updates (the
+    reference's mutable aux states, updated by the op's Forward in train
+    mode) are returned functionally in ``aux_updates`` (name → NDArray)
+    when ``autograd.is_training()``.
+    """
+    computed: Dict[Tuple[int, int], NDArray] = {}
+    aux_updates: Dict[str, NDArray] = {}
+    order: List[_Node] = []
+    seen = set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for p, _ in node.inputs:
+            visit(p)
+        order.append(node)
+
+    for n, _ in entries:
+        visit(n)
+
+    is_train = autograd.is_training()
+    for node in order:
+        if node.is_var():
+            if node.name not in values:
+                raise MXNetError(f"unbound argument {node.name!r}")
+            computed[(id(node), 0)] = values[node.name]
+            continue
+        in_nds = [computed[(id(p), i)] for p, i in node.inputs]
+        outs = _call_registry_op(node, in_nds)
+        if node.num_outputs is None:
+            node.num_outputs = len(outs)
+        for i, o in enumerate(outs):
+            computed[(id(node), i)] = o
+        if is_train and node.op in ("BatchNorm", "batch_norm") and \
+                not node.attrs.get("use_global_stats", False):
+            _batchnorm_aux_update(node, in_nds, aux_updates)
+    return [computed[(id(n), i)] for n, i in entries], aux_updates
+
+
+def _batchnorm_aux_update(node: _Node, in_nds, aux_updates) -> None:
+    x = in_nds[0]._data
+    mm_node = node.inputs[3][0]
+    mv_node = node.inputs[4][0]
+    momentum = float(node.attrs.get("momentum", 0.9))
+    axis = int(node.attrs.get("axis", 1)) % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x.astype(jnp.float32), axis=red)
+    var_ = jnp.var(x.astype(jnp.float32), axis=red)
+    mm, mv = in_nds[3]._data, in_nds[4]._data
+    aux_updates[mm_node.name] = NDArray(
+        momentum * mm + (1 - momentum) * mean.astype(mm.dtype))
+    aux_updates[mv_node.name] = NDArray(
+        momentum * mv + (1 - momentum) * var_.astype(mv.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+class Executor:
+    """Bound computation (reference ``Executor`` over GraphExecutor).
+
+    forward/backward each run as ONE jitted XLA program; backward
+    recomputes forward inside the fused grad program (XLA CSEs /
+    rematerializes — the reference's memory-planning pass analogue).
+    """
+
+    def __init__(self, symbol: Symbol, ctx, args: Dict[str, NDArray],
+                 args_grad: Optional[Dict[str, NDArray]],
+                 grad_req, aux_states: Dict[str, NDArray]):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self.aux_dict = dict(aux_states)
+        arg_names = symbol.list_arguments()
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in arg_names}
+        else:
+            self.grad_req = dict(grad_req)
+        missing = [n for n in arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+        for n in symbol.list_auxiliary_states():
+            if n not in self.aux_dict:
+                raise MXNetError(f"bind: missing auxiliary state {n!r}")
+        self.outputs: List[NDArray] = []
+        self._fwd_cache: Dict[bool, Any] = {}
+        self._bwd_cache: Dict[bool, Any] = {}
+        self._last_train = False
+        self._last_key = None
+
+    # -- forward -------------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+            else:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+        fwd = self._fwd_cache.get(is_train)
+        if fwd is None:
+            entries = self._symbol._entries
+
+            def raw(values, key):
+                _random.push_trace_key(key)
+                try:
+                    with autograd.pause(train_mode=is_train):
+                        nd_vals = {n: NDArray(v) for n, v in values.items()}
+                        outs, aux_up = interpret_nd(entries, nd_vals)
+                finally:
+                    _random.pop_trace_key()
+                return ([o._data for o in outs],
+                        {n: a._data for n, a in aux_up.items()})
+
+            fwd = jax.jit(raw)
+            self._fwd_cache[is_train] = fwd
+        values = {n: a._data for n, a in self.arg_dict.items()}
+        values.update({n: a._data for n, a in self.aux_dict.items()})
+        key = _random._next_key()
+        outs, aux_up = fwd(values, key)
+        self._last_train = is_train
+        self._last_key = key  # backward must replay the same dropout masks
+        for n, v in aux_up.items():
+            self.aux_dict[n]._set_data(v)
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    # -- backward ------------------------------------------------------------
+    def backward(self, out_grads=None) -> None:
+        """Gradients of outputs w.r.t. every arg with grad_req != 'null',
+        accumulated into grad_dict per grad_req (write|add)."""
+        diff_names = [n for n in self._symbol.list_arguments()
+                      if self.grad_req.get(n, "null") != "null"]
+        if not diff_names:
+            return
+        is_train = self._last_train
+        bwd_fn = self._bwd_cache.get(is_train)
+        if bwd_fn is None:
+            entries = self._symbol._entries
+
+            def raw_bwd(diff_vals, const_vals, key, ogs):
+                def f(dv):
+                    _random.push_trace_key(key)
+                    try:
+                        with autograd.pause(train_mode=is_train):
+                            nd_vals = {n: NDArray(v) for n, v in
+                                       {**const_vals, **dv}.items()}
+                            outs, _ = interpret_nd(entries, nd_vals)
+                    finally:
+                        _random.pop_trace_key()
+                    return tuple(o._data for o in outs)
+
+                _, vjp_fn = jax.vjp(f, diff_vals)
+                return vjp_fn(tuple(ogs))[0]
+
+            bwd_fn = jax.jit(raw_bwd)
+            self._bwd_cache[is_train] = bwd_fn
+        diff_vals = {n: self.arg_dict[n]._data for n in diff_names}
+        const_vals = {n: a._data for n, a in self.arg_dict.items()
+                      if n not in diff_vals}
+        const_vals.update({n: a._data for n, a in self.aux_dict.items()})
+        if out_grads is None:
+            ogs = [jnp.ones(o.shape, o._data.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        key = self._last_key if self._last_key is not None \
+            else _random.current_key()
+        grads = bwd_fn(diff_vals, const_vals, key, ogs)
+        for n in diff_names:
+            g = grads[n]
+            tgt = self.grad_dict.get(n)
+            if tgt is None:
+                tgt = NDArray(jnp.zeros_like(g))
+                self.grad_dict[n] = tgt
+            if self.grad_req.get(n) == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g)
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n) for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False) -> None:
+        for n, v in arg_params.items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._set_data(
+                    jnp.asarray(v._data, self.arg_dict[n].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {n!r}")
+        for n, v in (aux_params or {}).items():
+            if n in self.aux_dict:
+                self.aux_dict[n]._set_data(
+                    jnp.asarray(v._data, self.aux_dict[n].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {n!r}")
+
+    def reshape(self, **shapes) -> "Executor":
+        args = {n: nd_zeros(shapes.get(n, a.shape), self._ctx, a.dtype)
+                for n, a in self.arg_dict.items()}
+        grads = {n: nd_zeros(args[n].shape, self._ctx, a.dtype)
+                 for n, a in self.grad_dict.items()} or None
+        return Executor(self._symbol, self._ctx, args, grads,
+                        self.grad_req, dict(self.aux_dict))

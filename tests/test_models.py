@@ -108,14 +108,18 @@ def test_resnet_forward_and_train():
     mesh = pmesh.create_mesh(dp=-1)
     rules = ShardingRules([(r".*", P())])
     tx = optax.sgd(0.1, momentum=0.9)
-    tstate = pstep.init_state(params, tx, mesh, rules)
+    tstate = pstep.init_state(params, tx, mesh, rules,
+                              model_state=state0)
     step = pstep.make_train_step(resnet.loss_fn(cfg), tx, mesh, rules,
-                                 loss_has_aux=True)
+                                 has_state=True)
     batch = {"image": x, "label": jnp.arange(8, dtype=jnp.int32)}
-    tstate, l0, _ = step(tstate, batch)
+    tstate, l0 = step(tstate, batch)
     for _ in range(10):
-        tstate, loss, _ = step(tstate, batch)
+        tstate, loss = step(tstate, batch)
     assert float(loss) < float(l0)
+    # BN running stats accumulated across steps (not stuck at init)
+    mm = tstate.model_state["stem_bn"]["mean"]
+    assert float(jnp.abs(mm).sum()) > 0
 
 
 def test_graft_entry():

@@ -1,0 +1,230 @@
+"""Symbol API tests (reference tests/python/unittest/test_symbol.py +
+test_executor.py patterns: compose, infer_shape, bind, forward/backward
+consistency vs imperative autograd)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+
+sym = mx.sym
+
+
+def _init_executor(ex, scale=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.standard_normal(arr.shape).astype(np.float32) * scale
+
+
+def test_compose_and_list():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    out = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    assert out.list_arguments() == \
+        ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert out.list_outputs() == ["fc2_output"]
+    assert out.name == "fc2"
+
+
+def test_infer_shape():
+    data = sym.var("data")
+    out = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(8, 32))
+    assert arg_shapes == [(8, 32), (16, 32), (16,)]
+    assert out_shapes == [(8, 16)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    d = sym.var("data")
+    c = sym.Convolution(d, num_filter=8, kernel=(3, 3), pad=(1, 1), name="c")
+    b = sym.BatchNorm(c, name="bn")
+    arg_shapes, out_shapes, aux_shapes = b.infer_shape(data=(2, 3, 8, 8))
+    assert out_shapes == [(2, 8, 8, 8)]
+    assert (8, 3, 3, 3) in arg_shapes          # conv weight
+    assert aux_shapes == [(8,), (8,)]
+    assert b.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_simple_bind_forward_backward_matches_autograd():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="tanh", name="t")
+    out = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    ex = out.simple_bind(mx.cpu(), data=(8, 32))
+    _init_executor(ex)
+    x = mx.nd.array(np.random.default_rng(1).standard_normal((8, 32)))
+    ex.forward(is_train=True, data=x)
+    og = mx.nd.ones((8, 4))
+    ex.backward(out_grads=og)
+
+    # imperative replay with autograd
+    w1 = ex.arg_dict["fc1_weight"].copy()
+    b1 = ex.arg_dict["fc1_bias"].copy()
+    w2 = ex.arg_dict["fc2_weight"].copy()
+    b2 = ex.arg_dict["fc2_bias"].copy()
+    for a in (w1, b1, w2, b2):
+        a.attach_grad()
+    with autograd.record():
+        y = mx.nd.FullyConnected(
+            mx.nd.Activation(
+                mx.nd.FullyConnected(x, w1, b1, num_hidden=16),
+                act_type="tanh"),
+            w2, b2, num_hidden=4)
+    y.backward(og)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), y.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["fc1_weight"].asnumpy(),
+                               w1.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                               b2.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_grad_req_add_and_null():
+    data = sym.var("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = out.simple_bind(mx.cpu(), grad_req="add", data=(2, 8))
+    _init_executor(ex)
+    x = mx.nd.array(np.ones((2, 8)))
+    ex.forward(is_train=True, data=x)
+    ex.backward(out_grads=mx.nd.ones((2, 4)))
+    g1 = ex.grad_dict["fc_weight"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    ex.backward(out_grads=mx.nd.ones((2, 4)))
+    np.testing.assert_allclose(ex.grad_dict["fc_weight"].asnumpy(), 2 * g1,
+                               rtol=1e-6)
+
+
+def test_json_round_trip():
+    data = sym.var("data")
+    c = sym.Convolution(data, num_filter=4, kernel=(3, 3), name="c")
+    f = sym.Flatten(c, name="fl")
+    out = sym.FullyConnected(f, num_hidden=2, name="fc")
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    s1 = out.infer_shape(data=(1, 3, 8, 8))[1]
+    s2 = out2.infer_shape(data=(1, 3, 8, 8))[1]
+    assert s1 == s2
+
+
+def test_scalar_arithmetic_and_eval():
+    x = sym.var("x")
+    y = (2.0 * x + 1.0) ** 2 - x / 2.0
+    val = np.array([1.0, 2.0], np.float32)
+    r = y.eval(x=mx.nd.array(val))[0].asnumpy()
+    np.testing.assert_allclose(r, (2 * val + 1) ** 2 - val / 2, rtol=1e-6)
+
+
+def test_group_and_internals():
+    x = sym.var("x")
+    a = sym.sqrt(x, name="a")
+    b = sym.square(x, name="b")
+    g = sym.Group([a, b])
+    assert g.list_outputs() == ["a_output", "b_output"]
+    outs = g.eval(x=mx.nd.array(np.array([4.0])))
+    assert float(outs[0].asscalar()) == 2.0
+    assert float(outs[1].asscalar()) == 16.0
+    internals = b.get_internals()
+    xi = internals["x"]
+    assert xi.name == "x"
+
+
+def test_multi_output_split():
+    x = sym.var("x")
+    parts = sym.split(x, num_outputs=2, axis=1)
+    assert len(parts.list_outputs()) == 2
+    p0 = parts[0]
+    r = p0.eval(x=mx.nd.array(np.arange(8).reshape(2, 4)))[0]
+    np.testing.assert_allclose(r.asnumpy(), [[0, 1], [4, 5]])
+
+
+def test_batchnorm_aux_update_in_executor():
+    d = sym.var("data")
+    b = sym.BatchNorm(d, name="bn")
+    ex = b.simple_bind(mx.cpu(), data=(4, 3, 2, 2))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = mx.nd.array(np.random.default_rng(0).standard_normal((4, 3, 2, 2)) + 2)
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.all(np.abs(mm) > 0)     # updated toward batch mean ~2*0.1
+    # inference mode does not touch aux
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm)
+
+
+def test_export_symbolblock_round_trip(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                nn.BatchNorm(),
+                nn.MaxPool2D(2),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize(ctx=mx.cpu())
+    x = mx.nd.array(np.random.default_rng(0).standard_normal((2, 3, 8, 8)))
+    y0 = net(x)
+    prefix = str(tmp_path / "model")
+    net.export(prefix, epoch=3)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0003.params")
+
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0003.params")
+    y1 = sb(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+    sb.hybridize()
+    sb(x)
+    y2 = sb(x)
+    np.testing.assert_allclose(y0.asnumpy(), y2.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_symbolblock_autograd(tmp_path):
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    prefix = str(tmp_path / "d")
+    net._export_num_inputs = 1
+    net.export(prefix)
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    x = mx.nd.array(np.random.default_rng(0).standard_normal((2, 8)))
+    x.attach_grad()
+    with autograd.record():
+        z = (sb(x) ** 2).sum()
+    z.backward()
+    assert float(x.grad.abs().sum()) > 0
+
+
+def test_save_load_checkpoint(tmp_path):
+    data = sym.var("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_params = {"fc_weight": mx.nd.ones((4, 8)),
+                  "fc_bias": mx.nd.zeros((4,))}
+    prefix = str(tmp_path / "ckpt")
+    mx.model.save_checkpoint(prefix, 7, out, arg_params, {})
+    s2, args2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert s2.list_arguments() == out.list_arguments()
+    np.testing.assert_allclose(args2["fc_weight"].asnumpy(),
+                               np.ones((4, 8)))
+    assert aux2 == {}
+
+
+def test_dropout_symbol_train_vs_test():
+    x = sym.var("x")
+    d = sym.Dropout(x, p=0.5, name="drop")
+    ex = d.simple_bind(mx.cpu(), x=(100,))
+    v = mx.nd.ones((100,))
+    out_test = ex.forward(is_train=False, x=v)[0].asnumpy()
+    np.testing.assert_allclose(out_test, np.ones(100))
+    out_train = ex.forward(is_train=True, x=v)[0].asnumpy()
+    assert (out_train == 0).sum() > 10          # some dropped
+    assert np.allclose(out_train[out_train > 0], 2.0)  # scaled
