@@ -46,7 +46,8 @@ def __getattr__(name):
             "utils", "amp", "contrib", "rnn", "serde"}
     if name in lazy:
         mod = {"sym": "mxtpu.symbol", "np": "mxtpu.numpy",
-               "npx": "mxtpu.numpy_extension"}.get(name, f"mxtpu.{name}")
+               "npx": "mxtpu.numpy_extension",
+               "rnn": "mxtpu.gluon.rnn"}.get(name, f"mxtpu.{name}")
         m = importlib.import_module(mod)
         globals()[name] = m
         return m

@@ -405,8 +405,10 @@ class HybridBlock(Block):
             _TRACE_DEPTH.depth = getattr(_TRACE_DEPTH, "depth", 0) + 1
             try:
                 with autograd.pause(train_mode=training):
-                    wrapped = [NDArray(x) if not isinstance(x, NDArray)
-                               else x for x in xs]
+                    # wrap only traced array values; pass-through leaves
+                    # (None, python scalars) stay as-is
+                    wrapped = [NDArray(x) if isinstance(
+                        x, (jax.Array, jax.core.Tracer)) else x for x in xs]
                     args = _unflatten_nds(in_tree, wrapped, [0])
                     out = block.forward(*args)
             finally:

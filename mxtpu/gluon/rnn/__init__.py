@@ -1,0 +1,6 @@
+"""Gluon recurrent layers and cells (reference ``python/mxnet/gluon/rnn/``)."""
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU

@@ -1009,3 +1009,162 @@ def cumsum(a, axis=None, dtype=None, **kwargs):
 @register_op("full")
 def full_op(shape, val, ctx=None, dtype=None, **kwargs):
     return _nd_full(shape, val, ctx, dtype)
+
+
+# -- fused RNN (reference src/operator/rnn.cc / rnn_impl.h: cuDNN-packed
+# multi-layer LSTM/GRU/vanilla RNN). TPU-native: lax.scan over time per
+# layer — static shapes, differentiable, MXU-friendly gemms -----------------
+def rnn_gates(mode: str) -> int:
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_layout(mode, input_size, state_size, num_layers,
+                     bidirectional, projection_size=None):
+    """Offsets of each (layer, direction) i2h/h2h weight/bias in the packed
+    1-D parameter vector, cuDNN order: all weights (layer-major, then
+    direction), then all biases."""
+    ng = rnn_gates(mode)
+    d = 2 if bidirectional else 1
+    h = state_size
+    entries = []  # (kind, layer, dir, shape)
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else h * d
+        for dr in range(d):
+            entries.append(("i2h_weight", layer, dr, (ng * h, isz)))
+            entries.append(("h2h_weight", layer, dr, (ng * h, h)))
+    for layer in range(num_layers):
+        for dr in range(d):
+            entries.append(("i2h_bias", layer, dr, (ng * h,)))
+            entries.append(("h2h_bias", layer, dr, (ng * h,)))
+    layout = {}
+    off = 0
+    for kind, layer, dr, shape in entries:
+        n = 1
+        for s in shape:
+            n *= s
+        layout[(kind, layer, dr)] = (off, shape)
+        off += n
+    return layout, off
+
+
+def _rnn_single_direction(x, h0, c0, wih, whh, bih, bhh, mode,
+                          clip_min=None, clip_max=None):
+    """x (T,N,C), h0/c0 (N,H). Returns (out (T,N,H), hT[, cT]).
+
+    The input gemm is hoisted out of the scan — one big (T·N, C)×(C, G·H)
+    MXU matmul instead of T small ones."""
+    if mode == "lstm":
+        gx = jnp.einsum("tnc,gc->tng", x, wih) + bih + bhh
+
+        def body(carry, gx_t):
+            h, c = carry
+            gates = gx_t + h @ whh.T
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            if clip_min is not None and clip_max is not None:
+                c2 = jnp.clip(c2, clip_min, clip_max)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return (h2, c2), h2
+        (hT, cT), out = lax.scan(body, (h0, c0), gx)
+        return out, hT, cT
+    if mode == "gru":
+        # cuDNN gru: r,z,n gate order; n's h2h term is gated by r BEFORE
+        # adding, and bias split matters: gx already holds bih+bhh for all
+        # gates — recompute n's h2h with its own bias to match cuDNN
+        H = h0.shape[-1]
+        gx_rzn = jnp.einsum("tnc,gc->tng", x, wih) + bih
+
+        def body(h, inputs):
+            gx_t, = inputs
+            gh = h @ whh.T + bhh
+            r = jax.nn.sigmoid(gx_t[..., :H] + gh[..., :H])
+            z = jax.nn.sigmoid(gx_t[..., H:2 * H] + gh[..., H:2 * H])
+            n = jnp.tanh(gx_t[..., 2 * H:] + r * gh[..., 2 * H:])
+            h2 = (1 - z) * n + z * h
+            return h2, h2
+        hT, out = lax.scan(body, h0, (gx_rzn,))
+        return out, hT
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+    gx = jnp.einsum("tnc,gc->tng", x, wih) + bih + bhh
+
+    def body(h, gx_t):
+        h2 = act(gx_t + h @ whh.T)
+        return h2, h2
+    hT, out = lax.scan(body, h0, gx)
+    return out, hT
+
+
+@register_op("RNN")
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, **kwargs):
+    """Fused multi-layer RNN, reference semantics (src/operator/rnn.cc):
+    ``data`` (T, N, C) [TNC], ``parameters`` the cuDNN-packed 1-D vector,
+    ``state`` (L*D, N, H) initial hidden, ``state_cell`` likewise (LSTM).
+    Returns out (T, N, D*H) [+ final h [+ final c]] per state_outputs."""
+    if projection_size is not None:
+        raise NotImplementedError("RNN projection_size not supported")
+    mode = mode.lower()
+    ng = rnn_gates(mode)
+    d = 2 if bidirectional else 1
+    h = int(state_size)
+    L = int(num_layers)
+    is_lstm = mode == "lstm"
+    arrs = [data, parameters, state] + ([state_cell] if is_lstm else [])
+    drop = float(p)
+
+    def _f(x, params, h0, *rest):
+        c0 = rest[0] if is_lstm else None
+        input_size = x.shape[-1]
+        layout, total = rnn_param_layout(mode, input_size, h, L,
+                                         bidirectional)
+        if params.shape[0] != total:
+            raise ValueError(
+                f"RNN parameters size {params.shape[0]} != expected {total} "
+                f"(mode={mode}, input={input_size}, hidden={h}, layers={L}, "
+                f"bidirectional={bidirectional})")
+
+        def get(kind, layer, dr):
+            off, shape = layout[(kind, layer, dr)]
+            n = 1
+            for s in shape:
+                n *= s
+            return lax.dynamic_slice_in_dim(params, off, n).reshape(shape)
+
+        out = x
+        hTs, cTs = [], []
+        for layer in range(L):
+            outs_dir = []
+            for dr in range(d):
+                idx = layer * d + dr
+                xin = jnp.flip(out, axis=0) if dr == 1 else out
+                res = _rnn_single_direction(
+                    xin, h0[idx], c0[idx] if is_lstm else None,
+                    get("i2h_weight", layer, dr), get("h2h_weight", layer, dr),
+                    get("i2h_bias", layer, dr), get("h2h_bias", layer, dr),
+                    mode, lstm_state_clip_min, lstm_state_clip_max)
+                o = res[0]
+                if dr == 1:
+                    o = jnp.flip(o, axis=0)
+                outs_dir.append(o)
+                hTs.append(res[1])
+                if is_lstm:
+                    cTs.append(res[2])
+            out = outs_dir[0] if d == 1 else \
+                jnp.concatenate(outs_dir, axis=-1)
+            if drop > 0 and layer < L - 1 and autograd.is_training():
+                from . import random as _rnd
+                key = _rnd._next_key()
+                keep = jax.random.bernoulli(key, 1.0 - drop, out.shape)
+                out = jnp.where(keep, out / (1.0 - drop),
+                                jnp.zeros((), out.dtype))
+        hT = jnp.stack(hTs, axis=0)
+        if is_lstm:
+            return out, hT, jnp.stack(cTs, axis=0)
+        return out, hT
+
+    n_out = (3 if is_lstm else 2) if state_outputs else 1
+    if state_outputs:
+        return apply_op(_f, arrs, "RNN", n_out=n_out)
+    return apply_op(lambda *a: _f(*a)[0], arrs, "RNN")

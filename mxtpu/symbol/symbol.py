@@ -76,6 +76,8 @@ def _num_outputs(op: str, attrs: Dict[str, Any]) -> int:
         return int(attrs.get("num_outputs", 1))
     if op == "topk" and attrs.get("ret_typ") == "both":
         return 2
+    if op == "RNN" and attrs.get("state_outputs"):
+        return 3 if attrs.get("mode", "lstm") == "lstm" else 2
     return 1
 
 
@@ -138,12 +140,11 @@ class Symbol:
                 if n.name == index:
                     return Symbol([(n, i)])
             raise ValueError(f"no output named {index!r}")
-        if len(self._entries) == 1:
-            node, _ = self._entries[0]
-            if node.num_outputs is not None and node.num_outputs > 1:
-                if index >= node.num_outputs:
-                    raise IndexError(index)
-                return Symbol([(node, index)])
+        # entries always hold the symbol's outputs explicitly (multi-output
+        # op symbols carry one entry per output), so indexing is plain
+        # entry selection — never re-derive from the node's output count
+        if isinstance(index, slice):
+            return Symbol(self._entries[index])
         return Symbol([self._entries[index]])
 
     def __len__(self):

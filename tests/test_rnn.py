@@ -1,0 +1,192 @@
+"""RNN tests (reference tests/python/unittest/test_gluon_rnn.py
+patterns), with torch-CPU as independent ground truth for the fused
+layers (same cuDNN gate conventions)."""
+import numpy as np
+import pytest
+import torch
+
+import mxtpu as mx
+from mxtpu import autograd
+from mxtpu.gluon import rnn
+
+T, N, C, H = 5, 3, 4, 6
+
+
+def _copy_torch_weights(mx_layer, th, num_layers, bidirectional):
+    sd = th.state_dict()
+    for layer in range(num_layers):
+        for dr, pref in enumerate(["l", "r"][:2 if bidirectional else 1]):
+            sfx = f"l{layer}" + ("_reverse" if dr else "")
+            getattr(mx_layer, f"{pref}{layer}_i2h_weight").set_data(
+                mx.nd.array(sd[f"weight_ih_{sfx}"].numpy()))
+            getattr(mx_layer, f"{pref}{layer}_h2h_weight").set_data(
+                mx.nd.array(sd[f"weight_hh_{sfx}"].numpy()))
+            getattr(mx_layer, f"{pref}{layer}_i2h_bias").set_data(
+                mx.nd.array(sd[f"bias_ih_{sfx}"].numpy()))
+            getattr(mx_layer, f"{pref}{layer}_h2h_bias").set_data(
+                mx.nd.array(sd[f"bias_hh_{sfx}"].numpy()))
+
+
+@pytest.mark.parametrize("mode,bidirectional,num_layers", [
+    ("lstm", False, 1), ("lstm", True, 2),
+    ("gru", False, 1), ("gru", True, 2),
+    ("rnn_tanh", False, 2), ("rnn_relu", False, 1),
+])
+def test_fused_layer_vs_torch(mode, bidirectional, num_layers):
+    x = np.random.default_rng(0).standard_normal((T, N, C)).astype(np.float32)
+    if mode == "lstm":
+        mx_layer = rnn.LSTM(H, num_layers=num_layers,
+                            bidirectional=bidirectional)
+        th = torch.nn.LSTM(C, H, num_layers=num_layers,
+                           bidirectional=bidirectional)
+    elif mode == "gru":
+        mx_layer = rnn.GRU(H, num_layers=num_layers,
+                           bidirectional=bidirectional)
+        th = torch.nn.GRU(C, H, num_layers=num_layers,
+                          bidirectional=bidirectional)
+    else:
+        act = mode.split("_")[1]
+        mx_layer = rnn.RNN(H, num_layers=num_layers, activation=act,
+                           bidirectional=bidirectional)
+        th = torch.nn.RNN(C, H, num_layers=num_layers, nonlinearity=act,
+                          bidirectional=bidirectional)
+    mx_layer.initialize()
+    mx_layer(mx.nd.array(x))          # resolve deferred shapes
+    _copy_torch_weights(mx_layer, th, num_layers, bidirectional)
+    out = mx_layer(mx.nd.array(x)).asnumpy()
+    with torch.no_grad():
+        expected = th(torch.tensor(x))[0].numpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_lstm_states_and_ntc():
+    x = np.random.default_rng(1).standard_normal((N, T, C)).astype(np.float32)
+    layer = rnn.LSTM(H, layout="NTC", input_size=C)
+    layer.initialize()
+    states = layer.begin_state(N)
+    out, new_states = layer(mx.nd.array(x), states)
+    assert out.shape == (N, T, H)
+    assert new_states[0].shape == (1, N, H)
+    assert new_states[1].shape == (1, N, H)
+    # final state equals last output step
+    np.testing.assert_allclose(new_states[0].asnumpy()[0],
+                               out.asnumpy()[:, -1], rtol=1e-5, atol=1e-6)
+
+
+def test_cell_unroll_matches_fused():
+    x = np.random.default_rng(2).standard_normal((T, N, C)).astype(np.float32)
+    lstm = rnn.LSTM(H, input_size=C)
+    lstm.initialize()
+    cell = rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    cell.i2h_weight.set_data(lstm.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(lstm.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(lstm.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(lstm.l0_h2h_bias.data())
+    out_l = lstm(mx.nd.array(x)).asnumpy()
+    out_c, states = cell.unroll(T, mx.nd.array(x.transpose(1, 0, 2)),
+                                layout="NTC")
+    np.testing.assert_allclose(out_l.transpose(1, 0, 2), out_c.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    assert len(states) == 2
+
+
+@pytest.mark.parametrize("cell_cls", [rnn.RNNCell, rnn.LSTMCell, rnn.GRUCell])
+def test_cell_step_shapes(cell_cls):
+    cell = cell_cls(H, input_size=C)
+    cell.initialize()
+    x = mx.nd.ones((N, C))
+    states = cell.begin_state(N)
+    out, new_states = cell(x, states)
+    assert out.shape == (N, H)
+    assert len(new_states) == len(states)
+
+
+def test_rnn_gradient_flows():
+    layer = rnn.GRU(H, input_size=C)
+    layer.initialize()
+    x = mx.nd.array(np.random.default_rng(3).standard_normal((T, N, C)))
+    with autograd.record():
+        loss = (layer(x) ** 2).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert float(g.abs().sum()) > 0
+
+
+def test_rnn_hybridize_consistency():
+    layer = rnn.LSTM(H, num_layers=2, input_size=C)
+    layer.initialize()
+    x = mx.nd.array(np.random.default_rng(4).standard_normal((T, N, C)))
+    y0 = layer(x).asnumpy()
+    layer.hybridize()
+    layer(x)
+    y1 = layer(x).asnumpy()
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, input_size=C))
+    stack.add(rnn.LSTMCell(H, input_size=H))
+    stack.initialize()
+    x = mx.nd.ones((N, T, C))
+    out, states = stack.unroll(T, x, layout="NTC")
+    assert out.shape == (N, T, H)
+    assert len(states) == 4
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.GRUCell(C, input_size=C))
+    cell.initialize()
+    x = mx.nd.ones((N, C))
+    states = cell.begin_state(N)
+    out, _ = cell(x, states)
+    assert out.shape == (N, C)
+    # residual: out = base_out + x
+    base_out, _ = cell.base_cell(x, states)
+    np.testing.assert_allclose(out.asnumpy(),
+                               (base_out + x).asnumpy(), rtol=1e-6)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(H, input_size=C),
+                               rnn.LSTMCell(H, input_size=C))
+    bi.initialize()
+    x = mx.nd.ones((N, T, C))
+    out, states = bi.unroll(T, x, layout="NTC")
+    assert out.shape == (N, T, 2 * H)
+    assert len(states) == 4
+
+
+def test_dropout_cell():
+    cell = rnn.DropoutCell(0.5)
+    x = mx.nd.ones((N, C))
+    out, states = cell(x, [])
+    np.testing.assert_allclose(out.asnumpy(), np.ones((N, C)))
+    with autograd.record(train_mode=True):
+        out_t, _ = cell(x, [])
+    dropped = (out_t.asnumpy() == 0).sum()
+    assert dropped > 0
+
+
+def test_rnn_layer_export_symbolblock(tmp_path):
+    from mxtpu import gluon
+    layer = rnn.GRU(H, input_size=C)
+    layer.initialize()
+    x = mx.nd.array(np.random.default_rng(5).standard_normal((T, N, C)))
+    states = layer.begin_state(N)
+    y0, _ = layer(x, states)
+
+    import mxtpu.symbol as sym
+    data = sym.var("data")
+    s0 = sym.var("state0")
+    out_sym = layer._trace_symbol(data, [s0])
+    graph = out_sym[0] if isinstance(out_sym, (tuple, list)) else out_sym
+    ex = graph.bind(mx.cpu(),
+                    {**{p.name: p.data()
+                        for p in layer.collect_params().values()},
+                     "data": x, "state0": states[0]},
+                    grad_req="null")
+    y1 = ex.forward()[0]
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
