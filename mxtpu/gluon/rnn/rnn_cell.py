@@ -74,16 +74,26 @@ class RecurrentCell(HybridBlock):
                 batch, ctx=seq[0].context, dtype=seq[0].dtype)
         states = begin_state
         outputs = []
+        all_states = [] if valid_length is not None else None
         for i in range(length):
             out, states = self(seq[i], states)
             outputs.append(out)
+            if all_states is not None:
+                all_states.append(states)
         if valid_length is not None:
             stacked = nd.stack(*outputs, axis=axis)
             outputs = nd.SequenceMask(
                 stacked, sequence_length=valid_length,
                 use_sequence_length=True, axis=axis)
-            # correct the final states to those at each sequence's end
-            # (reference semantics) — gather per-batch last states
+            # final states = the states at each sequence's OWN last valid
+            # step, not the padded step T (reference: SequenceLast over the
+            # per-step state stack)
+            states = []
+            for si in range(len(begin_state)):
+                per_step = nd.stack(*[s[si] for s in all_states], axis=0)
+                states.append(nd.SequenceLast(
+                    per_step, sequence_length=valid_length,
+                    use_sequence_length=True, axis=0))
             merge_outputs = True if merge_outputs is None else merge_outputs
             if not merge_outputs:
                 outputs = [o.squeeze(axis=axis) for o in
@@ -424,13 +434,31 @@ class BidirectionalCell(RecurrentCell):
         l_cell, r_cell = self._children.values()
         n_l = len(l_cell.state_info())
         l_out, l_states = l_cell.unroll(
-            length, seq, begin_state[:n_l], layout="TNC"
-            if False else layout, merge_outputs=False,
-            valid_length=valid_length)
+            length, seq, begin_state[:n_l], layout=layout,
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is not None:
+            # reverse each sequence within its valid length so the
+            # backward pass starts at the true last step, not padding
+            # (reference: SequenceReverse with sequence_length)
+            stacked = nd.stack(*seq, axis=0)           # (T, N, C)
+            rev = nd.SequenceReverse(stacked, sequence_length=valid_length,
+                                     use_sequence_length=True)
+            rseq = [rev.slice_axis(axis=0, begin=i, end=i + 1)
+                    .squeeze(axis=0) for i in range(length)]
+        else:
+            rseq = list(reversed(seq))
         r_out, r_states = r_cell.unroll(
-            length, list(reversed(seq)), begin_state[n_l:],
-            layout=layout, merge_outputs=False, valid_length=None)
-        r_out = list(reversed(r_out))
+            length, rseq, begin_state[n_l:],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        if valid_length is not None:
+            rstacked = nd.stack(*r_out, axis=0)
+            runrev = nd.SequenceReverse(
+                rstacked, sequence_length=valid_length,
+                use_sequence_length=True)
+            r_out = [runrev.slice_axis(axis=0, begin=i, end=i + 1)
+                     .squeeze(axis=0) for i in range(length)]
+        else:
+            r_out = list(reversed(r_out))
         outputs = [nd.concat(l, r, dim=1) for l, r in zip(l_out, r_out)]
         if merge_outputs is None or merge_outputs:
             outputs = nd.stack(*outputs, axis=axis)

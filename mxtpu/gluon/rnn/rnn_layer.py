@@ -55,7 +55,7 @@ class _RNNLayer(HybridBlock):
                         p = self.params.get(
                             f"{pname}_{nm}", shape=shape, init=init,
                             dtype=dtype, allow_deferred_init=True)
-                        self._reg_params[f"{pname}_{nm}"] = p
+                        # setattr registers in _reg_params via Block
                         setattr(self, f"{pname}_{nm}", p)
 
     @property
@@ -77,13 +77,12 @@ class _RNNLayer(HybridBlock):
                 for i in self.state_info(batch_size)]
 
     def infer_shape(self, x, *args):
-        isz = x.shape[-1] if self._layout == "NTC" or x.ndim == 3 \
-            else x.shape[-1]
-        for layer in range(self._num_layers):
-            for prefix in ["l", "r"][:self._dir]:
-                p = getattr(self, f"{prefix}{layer}_i2h_weight")
-                if layer == 0:
-                    p.shape = (self._gates * self._hidden_size, isz)
+        # only layer 0's i2h depends on the input feature dim (last axis
+        # in both TNC and NTC layouts)
+        isz = x.shape[-1]
+        for prefix in ["l", "r"][:self._dir]:
+            p = getattr(self, f"{prefix}0_i2h_weight")
+            p.shape = (self._gates * self._hidden_size, isz)
 
     def __call__(self, inputs, states=None):
         # keep the no-states call unary so the cached-op signature stays
@@ -115,6 +114,28 @@ class _RNNLayer(HybridBlock):
         if skip_states:
             return out[0]
         return out
+
+    def _symbolic_call(self, *args):
+        """Trace with Symbol inputs. Without explicit states, synthesize
+        zero begin-states as ops on the data symbol (batch size flows from
+        the input at bind time) and return only the sequence output —
+        mirroring forward()'s state-less contract."""
+        import mxtpu.symbol as sym
+        param_syms = {k: sym.var(p.name) for k, p in self._reg_params.items()}
+        x = args[0]
+        states = args[1] if len(args) > 1 else None
+        skip_states = states is None
+        if skip_states:
+            xt = sym.swapaxes(x, dim1=0, dim2=1) if self._layout == "NTC" \
+                else x
+            n = self._num_layers * self._dir
+            states = [sym._rnn_init_state(xt, num_states=n,
+                                          state_size=self._hidden_size)]
+            if self._mode == "lstm":
+                states.append(sym._rnn_init_state(
+                    xt, num_states=n, state_size=self._hidden_size))
+        out = self.hybrid_forward(sym, x, states, **param_syms)
+        return out[0] if skip_states else out
 
     def hybrid_forward(self, F, x, states, **params):
         if self._layout == "NTC":

@@ -1094,6 +1094,16 @@ def _rnn_single_direction(x, h0, c0, wih, whh, bih, bhh, mode,
     return out, hT
 
 
+@register_op("_rnn_init_state")
+def _rnn_init_state(data, num_states=1, state_size=None, **kwargs):
+    """Zero initial RNN state derived from a TNC input: (num_states, N, H).
+    Exists as an op so symbolic traces of state-less RNN layer calls stay
+    a pure function of 'data' (batch size comes from the input)."""
+    return apply_op(
+        lambda x: jnp.zeros((num_states, x.shape[1], int(state_size)),
+                            x.dtype), [data], "_rnn_init_state")
+
+
 @register_op("RNN")
 def RNN(data, parameters, state, state_cell=None, state_size=None,
         num_layers=1, mode="lstm", bidirectional=False, p=0.0,

@@ -1,0 +1,84 @@
+"""Model-zoo tests (reference tests/python/unittest/test_gluon_model_zoo.py:
+instantiate every registered model, forward-shape check, hybridize).
+
+Spatial sizes are reduced where the architecture allows (deferred Dense
+shapes adapt) to keep single-core-CPU eager runtimes sane; DenseNet and
+Inception have fixed final-pool geometry and run at full size under the
+``slow`` marker."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.gluon.model_zoo import vision
+
+
+def _check(name, size, classes=10):
+    net = vision.get_model(name, classes=classes)
+    net.initialize()
+    x = mx.nd.array(np.random.default_rng(0).standard_normal(
+        (2, 3, size, size)).astype(np.float32))
+    y = net(x)
+    assert y.shape == (2, classes), (name, y.shape)
+    return net, x, y
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v1", 112),
+    ("resnet34_v1", 112),
+    ("resnet18_v2", 112),
+    ("squeezenet1.1", 112),
+    ("mobilenet0.25", 112),
+    ("mobilenetv2_0.25", 112),
+    ("vgg11", 64),
+    ("alexnet", 128),
+])
+def test_model_forward_shape(name, size):
+    _check(name, size)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("resnet1000_v9")
+
+
+def test_resnet_hybridize_and_save_load(tmp_path):
+    net, x, y0 = _check("resnet18_v1", 112)
+    net.hybridize()
+    net(x)
+    y1 = net(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=2e-4,
+                               atol=2e-4)
+    f = str(tmp_path / "r18.params")
+    net.save_parameters(f)
+    net2 = vision.get_model("resnet18_v1", classes=10)
+    net2.load_parameters(f)
+    y2 = net2(x)
+    np.testing.assert_allclose(y0.asnumpy(), y2.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_bottleneck_resnet50_builds():
+    # structural check only (no 224 forward): param shapes after a tiny
+    # forward through the first stage would still cost a full forward, so
+    # verify the block graph composes at 64px with deferred shapes
+    net = vision.get_model("resnet50_v1", classes=7)
+    net.initialize()
+    x = mx.nd.array(np.random.default_rng(1).standard_normal(
+        (1, 3, 64, 64)).astype(np.float32))
+    y = net(x)
+    assert y.shape == (1, 7)
+
+
+@pytest.mark.slow
+def test_densenet_and_inception():
+    net = vision.get_model("densenet121", classes=5)
+    net.initialize()
+    x = mx.nd.array(np.random.default_rng(2).standard_normal(
+        (1, 3, 224, 224)).astype(np.float32))
+    assert net(x).shape == (1, 5)
+
+    net = vision.get_model("inceptionv3", classes=5)
+    net.initialize()
+    x = mx.nd.array(np.random.default_rng(3).standard_normal(
+        (1, 3, 299, 299)).astype(np.float32))
+    assert net(x).shape == (1, 5)

@@ -190,3 +190,52 @@ def test_rnn_layer_export_symbolblock(tmp_path):
     y1 = ex.forward()[0]
     np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_unroll_valid_length_states():
+    # final states must come from each sequence's own last valid step
+    cell = rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    x = mx.nd.array(np.random.default_rng(6).standard_normal((2, 4, C)))
+    vl = mx.nd.array(np.array([2.0, 4.0]))
+    out, states = cell.unroll(4, x, layout="NTC", valid_length=vl)
+    # batch 0: unroll just the first 2 steps manually
+    out2, states2 = cell.unroll(2, x.slice_axis(axis=1, begin=0, end=2),
+                                layout="NTC")
+    np.testing.assert_allclose(states[0].asnumpy()[0],
+                               states2[0].asnumpy()[0], rtol=1e-5, atol=1e-6)
+    # masked region of the output is zero
+    assert np.allclose(out.asnumpy()[0, 2:], 0)
+
+
+def test_bidirectional_valid_length():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(H, input_size=C),
+                               rnn.LSTMCell(H, input_size=C))
+    bi.initialize()
+    x_np = np.random.default_rng(7).standard_normal((2, 4, C)).astype(np.float32)
+    x = mx.nd.array(x_np)
+    vl = mx.nd.array(np.array([2.0, 4.0]))
+    out, states = bi.unroll(4, x, layout="NTC", valid_length=vl)
+    # short sequence: compare against unrolling only its valid 2 steps
+    bi2_out, _ = bi.unroll(2, x.slice_axis(axis=1, begin=0, end=2),
+                           layout="NTC")
+    np.testing.assert_allclose(out.asnumpy()[0, :2],
+                               bi2_out.asnumpy()[0], rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_layer_stateless_export(tmp_path):
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(rnn.LSTM(H, input_size=C), nn.Dense(3, flatten=False))
+    net.initialize()
+    x = mx.nd.array(np.random.default_rng(8).standard_normal((T, N, C)))
+    y0 = net(x)
+    prefix = str(tmp_path / "rnnlm")
+    net.export(prefix)
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    y1 = sb(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
