@@ -61,8 +61,11 @@ def apply_op(raw_fn: Callable, arrays: Sequence["NDArray"], name: str = "",
     parents = _parents_of(arrays)
     datas = [a._data if isinstance(a, NDArray) else a for a in arrays]
     out, node = autograd.invoke(raw_fn, datas, parents, name)
+    # results take the class of the first array input, so mx.np arrays
+    # (NDArray subclass with numpy semantics) propagate through every op
+    cls = next((type(a) for a in arrays if isinstance(a, NDArray)), NDArray)
     if n_out == 1:
-        res = NDArray(out)
+        res = cls(out)
         if node is not None:
             res._ag = (node, 0)
         if _NAIVE:
@@ -70,7 +73,7 @@ def apply_op(raw_fn: Callable, arrays: Sequence["NDArray"], name: str = "",
         return res
     results = []
     for i, o in enumerate(out):
-        r = NDArray(o)
+        r = cls(o)
         if node is not None:
             r._ag = (node, i)
         results.append(r)
@@ -182,23 +185,22 @@ class NDArray:
         dev = ctx.jax_device()
         if dev in self._data.devices():
             return self
-        return NDArray(jax.device_put(self._data, dev))
+        return type(self)(jax.device_put(self._data, dev))
 
     as_in_ctx = as_in_context
 
     def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
         if isinstance(other, Context):
-            return NDArray(jax.device_put(self._data, other.jax_device()))
+            return type(self)(jax.device_put(self._data, other.jax_device()))
         other._set_data(jnp.asarray(self._data, other._data.dtype))
         return other
 
     def copy(self) -> "NDArray":
-        return NDArray(self._data + 0 if self._data.dtype != jnp.bool_
-                       else self._data.copy())
+        return type(self)(self._data + 0 if self._data.dtype != jnp.bool_
+                          else self._data.copy())
 
     def detach(self) -> "NDArray":
-        r = NDArray(self._data)
-        return r
+        return type(self)(self._data)
 
     def to_dlpack(self):
         return jax.dlpack.to_dlpack(self._data)
@@ -236,7 +238,7 @@ class NDArray:
     # -- autograd -----------------------------------------------------------
     def attach_grad(self, grad_req: str = "write", stype=None) -> None:
         """Allocate a gradient buffer and mark this array as a variable."""
-        self.grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        self.grad = type(self)(jnp.zeros(self.shape, self._data.dtype))
         self._ag_leaf = autograd.Leaf(self, grad_req)
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
@@ -425,10 +427,10 @@ class NDArray:
         return ops.dot(self, other)
 
     def zeros_like(self):
-        return NDArray(jnp.zeros_like(self._data))
+        return type(self)(jnp.zeros_like(self._data))
 
     def ones_like(self):
-        return NDArray(jnp.ones_like(self._data))
+        return type(self)(jnp.ones_like(self._data))
 
     def asfloat(self):
         return self.astype("float32")

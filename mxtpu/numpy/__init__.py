@@ -1,0 +1,311 @@
+"""mx.np — the NumPy-semantics array frontend (reference
+``python/mxnet/numpy/`` over ``src/operator/numpy/np_*`` [path cites —
+unverified], MXNet 1.6+).
+
+Where the reference re-implemented ~60k LoC of NumPy-compatible CUDA
+kernels, here jax.numpy IS the NumPy-semantics kernel library — this
+module provides the ``mx.np.ndarray`` type (an NDArray subclass whose
+comparison/indexing semantics follow NumPy: bool results, zero-dim
+arrays) and a function namespace that routes every call through the
+autograd-aware ``apply_op`` funnel, so ``mx.np`` composes with
+``mx.autograd`` and hybridize exactly like ``mx.nd``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import dtype_np
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, apply_op
+
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
+           "linspace", "eye", "asarray", "from_nd"]
+
+_np_default_dtype = _onp.float32
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array: bool comparisons, numpy dtype promotion."""
+
+    def _cmp(self, other, raw):
+        if other is None:
+            # numpy semantics: comparison with None is elementwise
+            # False (True for !=), never a TypeError
+            val = raw is jnp.not_equal
+            return ndarray(jnp.full(self.shape, val, jnp.bool_))
+        if isinstance(other, NDArray):
+            return apply_op(lambda a, b: raw(a, b), [self, other], "cmp")
+        try:
+            return apply_op(lambda a: raw(a, other), [self], "cmp")
+        except TypeError:
+            return NotImplemented
+
+    def __eq__(self, o): return self._cmp(o, jnp.equal)
+    def __ne__(self, o): return self._cmp(o, jnp.not_equal)
+    def __gt__(self, o): return self._cmp(o, jnp.greater)
+    def __ge__(self, o): return self._cmp(o, jnp.greater_equal)
+    def __lt__(self, o): return self._cmp(o, jnp.less)
+    def __le__(self, o): return self._cmp(o, jnp.less_equal)
+
+    __hash__ = NDArray.__hash__
+
+    def as_nd_ndarray(self) -> NDArray:
+        r = NDArray(self._data)
+        r._ag = self._ag
+        r._ag_leaf = self._ag_leaf
+        r.grad = self.grad
+        return r
+
+    def asnumpy(self) -> _onp.ndarray:
+        return _onp.asarray(self._data)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    @property
+    def T(self):
+        return apply_op(lambda x: x.T, [self], "T")
+
+    def reshape(self, *shape, **kwargs):
+        # numpy reshape (no MXNet 0-copy magic values)
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return apply_op(lambda x: jnp.reshape(x, shape), [self], "reshape")
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return apply_op(lambda x: jnp.std(x, axis=axis, ddof=ddof,
+                                          keepdims=keepdims), [self], "std")
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return apply_op(lambda x: jnp.var(x, axis=axis, ddof=ddof,
+                                          keepdims=keepdims), [self], "var")
+
+    def all(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.all(x, axis=axis, keepdims=keepdims),
+                        [self], "all")
+
+    def any(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.any(x, axis=axis, keepdims=keepdims),
+                        [self], "any")
+
+
+def from_nd(a: NDArray) -> ndarray:
+    """View an mx.nd array as mx.np (shares buffer, tape link, and grad
+    buffer — gradients written to either view are visible from both)."""
+    r = ndarray(a._data)
+    r._ag = a._ag
+    r._ag_leaf = a._ag_leaf
+    r.grad = a.grad
+    return r
+
+
+def _wrap_value(v) -> Any:
+    return ndarray(v) if isinstance(v, jax.Array) else v
+
+
+def _invoke(jfn, name, args, kwargs):
+    """Route a jax.numpy call through apply_op for autograd taping.
+
+    NDArray leaves anywhere in args/kwargs (including inside lists, e.g.
+    ``concatenate([a, b])``) become tape inputs; everything else is
+    closed over as constants."""
+    nd_args = []
+
+    class _Slot:
+        __slots__ = ("i",)
+
+        def __init__(self, i):
+            self.i = i
+
+    def _mark(a):
+        if isinstance(a, NDArray):
+            nd_args.append(a)
+            return _Slot(len(nd_args) - 1)
+        return a
+
+    spec = jax.tree_util.tree_map(
+        _mark, (tuple(args), kwargs),
+        is_leaf=lambda a: isinstance(a, NDArray))
+
+    def raw(*datas):
+        pos, kws = jax.tree_util.tree_map(
+            lambda v: datas[v.i] if isinstance(v, _Slot) else v, spec)
+        return jfn(*pos, **kws)
+
+    if not nd_args:
+        return ndarray(jnp.asarray(jfn(*args, **kwargs)))
+    if name in _HOST_FNS:
+        # shape/ndim/size-style queries: plain host values, no tape
+        return raw(*[a._data for a in nd_args])
+    # multi-output functions need per-output wraps; known names avoid an
+    # eval_shape probe on the hot single-output path
+    if name in _MULTI_OUT_FNS:
+        try:
+            out_struct = jax.eval_shape(raw, *[a._data for a in nd_args])
+        except Exception:
+            # data-dependent output shape (nonzero, unique): run eagerly,
+            # untaped (not differentiable anyway)
+            out = raw(*[a._data for a in nd_args])
+            return jax.tree_util.tree_map(
+                lambda v: ndarray(v) if isinstance(v, jax.Array) else v,
+                out)
+        if isinstance(out_struct, (tuple, list)):
+            res = apply_op(lambda *d: tuple(raw(*d)), nd_args, name,
+                           n_out=len(out_struct))
+            return list(res) if isinstance(out_struct, list) else res
+    return apply_op(raw, nd_args, name)
+
+
+# functions returning host Python values (no tape, no ndarray wrap)
+_HOST_FNS = {"shape", "ndim", "size", "iscomplexobj", "isrealobj",
+             "result_type", "can_cast", "broadcast_shapes", "issubdtype"}
+# functions that (can) return multiple arrays
+_MULTI_OUT_FNS = {"split", "array_split", "hsplit", "vsplit", "dsplit",
+                  "meshgrid", "divmod", "frexp", "modf", "unique",
+                  "nonzero", "where", "histogram", "histogram2d",
+                  "histogramdd", "gradient", "linalg_eigh", "linalg_qr",
+                  "linalg_svd", "linalg_slogdet", "broadcast_arrays",
+                  "atleast_1d", "atleast_2d", "atleast_3d", "unravel_index"}
+
+
+class _SubmoduleProxy:
+    """np.linalg / np.fft: route every function through the autograd
+    funnel so mx.np arrays and taping work (finding: raw jnp submodules
+    can't consume NDArrays)."""
+
+    def __init__(self, mod, prefix):
+        self._mod = mod
+        self._prefix = prefix
+
+    def __getattr__(self, fname):
+        jfn = getattr(self._mod, fname)
+        if not callable(jfn):
+            return jfn
+
+        def fn(*args, **kwargs):
+            out = _invoke(jfn, f"{self._prefix}_{fname}", args, kwargs)
+            if isinstance(out, NDArray) and not isinstance(out, ndarray):
+                return from_nd(out)
+            return out
+        fn.__name__ = fname
+        return fn
+
+    def __dir__(self):
+        return dir(self._mod)
+
+
+def __getattr__(name):
+    if name == "random":
+        import importlib
+        m = importlib.import_module("mxtpu.numpy.random")
+        globals()["random"] = m
+        return m
+    if name in ("linalg", "fft"):
+        proxy = _SubmoduleProxy(getattr(jnp, name), name)
+        globals()[name] = proxy
+        return proxy
+    jfn = getattr(jnp, name, None)
+    if jfn is None or not callable(jfn):
+        # constants (pi, e, inf, nan, newaxis, dtypes)
+        if hasattr(jnp, name):
+            return getattr(jnp, name)
+        if hasattr(_onp, name) and not callable(getattr(_onp, name)):
+            return getattr(_onp, name)
+        raise AttributeError(f"module 'mxtpu.numpy' has no attribute "
+                             f"{name!r}")
+
+    def fn(*args, **kwargs):
+        out = _invoke(jfn, name, args, kwargs)
+        if isinstance(out, tuple):
+            return tuple(o if isinstance(o, ndarray) else
+                         (ndarray(o._data) if isinstance(o, NDArray)
+                          else o) for o in out)
+        if isinstance(out, NDArray) and not isinstance(out, ndarray):
+            return from_nd(out)
+        return out
+
+    fn.__name__ = name
+    fn.__qualname__ = f"np.{name}"
+    fn.__doc__ = getattr(jfn, "__doc__", None)
+    globals()[name] = fn
+    return fn
+
+
+def _device(ctx):
+    return (ctx or current_context()).jax_device()
+
+
+def array(obj, dtype=None, ctx=None) -> ndarray:
+    if isinstance(obj, NDArray):
+        obj = obj._data
+        return ndarray(obj.astype(dtype_np(dtype)) if dtype is not None
+                       else obj)
+    np_val = _onp.asarray(obj)
+    if dtype is None:
+        # numpy-frontend default: float64 inputs demote to float32 on
+        # accelerator (reference mx.np default_dtype behavior)
+        dtype = _np_default_dtype if np_val.dtype == _onp.float64 \
+            else np_val.dtype
+    np_val = np_val.astype(dtype_np(dtype))
+    return ndarray(jax.device_put(np_val, _device(ctx)))
+
+
+asarray = array
+
+
+def zeros(shape, dtype=None, ctx=None, order="C") -> ndarray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(_device(ctx)):
+        return ndarray(jnp.zeros(shape, dtype_np(dtype)))
+
+
+def ones(shape, dtype=None, ctx=None, order="C") -> ndarray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(_device(ctx)):
+        return ndarray(jnp.ones(shape, dtype_np(dtype)))
+
+
+def empty(shape, dtype=None, ctx=None, order="C") -> ndarray:
+    return zeros(shape, dtype, ctx)
+
+
+def full(shape, fill_value, dtype=None, ctx=None) -> ndarray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(_device(ctx)):
+        out = jnp.full(shape, fill_value)
+        if dtype is not None:
+            out = out.astype(dtype_np(dtype))
+        return ndarray(out)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None) -> ndarray:
+    with jax.default_device(_device(ctx)):
+        return ndarray(jnp.arange(start, stop, step,
+                                  dtype_np(dtype) if dtype else None))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    with jax.default_device(_device(ctx)):
+        out = jnp.linspace(start, stop, num, endpoint=endpoint,
+                           retstep=retstep, dtype=dtype_np(dtype)
+                           if dtype else None, axis=axis)
+        if retstep:
+            return ndarray(out[0]), out[1]
+        return ndarray(out)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None) -> ndarray:
+    with jax.default_device(_device(ctx)):
+        return ndarray(jnp.eye(N, M, k,
+                               dtype_np(dtype) if dtype else None))

@@ -1,0 +1,98 @@
+"""mx.np.random (reference ``python/mxnet/numpy/random.py``): NumPy-style
+sampling over the framework RNG (Threefry keys, see
+mxtpu/ndarray/random.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import random as _rnd
+from ..ndarray.ndarray import NDArray
+from . import ndarray as np_ndarray
+
+__all__ = ["uniform", "normal", "randint", "rand", "randn", "choice",
+           "shuffle", "seed", "beta", "gamma", "exponential", "multinomial"]
+
+
+def seed(s):
+    _rnd.seed(s)
+
+
+def _np(x):
+    return np_ndarray(x._data) if isinstance(x, NDArray) else np_ndarray(x)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    return _np(_rnd.uniform(low, high, shape=size, dtype=dtype, ctx=ctx))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    return _np(_rnd.normal(loc, scale, shape=size, dtype=dtype, ctx=ctx))
+
+
+def randint(low, high=None, size=None, dtype="int64", ctx=None):
+    # int64 only materializes under MXNET_ENABLE_X64 (TPU dtype policy)
+    return _np(_rnd.randint(low, high, shape=size, dtype=dtype, ctx=ctx))
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
+    return _np(_rnd.gamma(shape, scale, shape=size, dtype=dtype, ctx=ctx))
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None):
+    return _np(_rnd.exponential(scale, shape=size, dtype=dtype, ctx=ctx))
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    key = _rnd._next_key()
+    k1, k2 = jax.random.split(key)
+    size = (size,) if isinstance(size, int) else (size or ())
+    ga = jax.random.gamma(k1, a, shape=size)
+    gb = jax.random.gamma(k2, b, shape=size)
+    return np_ndarray((ga / (ga + gb)).astype(jnp.float32))
+
+
+def multinomial(n, pvals, size=None):
+    key = _rnd._next_key()
+    size = (size,) if isinstance(size, int) else (size or ())
+    pv = pvals._data if isinstance(pvals, NDArray) else jnp.asarray(pvals)
+    draws = jax.random.categorical(
+        key, jnp.log(pv), shape=tuple(size) + (n,))
+    counts = jax.vmap(lambda d: jnp.bincount(d, length=pv.shape[-1]))(
+        draws.reshape(-1, n)) if size else \
+        jnp.bincount(draws.reshape(-1), length=pv.shape[-1])
+    if size:
+        counts = counts.reshape(tuple(size) + (pv.shape[-1],))
+    import jax as _jax
+    return np_ndarray(counts.astype(
+        jnp.int64 if _jax.config.jax_enable_x64 else jnp.int32))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    key = _rnd._next_key()
+    size_t = (size,) if isinstance(size, int) else (size or ())
+    if isinstance(a, int):
+        arr = jnp.arange(a)
+    else:
+        arr = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    pv = None if p is None else (p._data if isinstance(p, NDArray)
+                                 else jnp.asarray(p))
+    out = jax.random.choice(key, arr, shape=tuple(size_t), replace=replace,
+                            p=pv)
+    return np_ndarray(out)
+
+
+def shuffle(x):
+    key = _rnd._next_key()
+    if isinstance(x, NDArray):
+        x._set_data(jax.random.permutation(key, x._data, axis=0))
+        return
+    raise TypeError("shuffle expects an mx.np ndarray")

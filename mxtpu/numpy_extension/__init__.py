@@ -1,0 +1,84 @@
+"""mx.npx — numpy_extension (reference ``python/mxnet/numpy_extension/``):
+the neural-net ops that aren't part of NumPy (relu, softmax, batch_norm,
+convolution, ...) exposed over mx.np arrays, plus the np-mode switches.
+"""
+from __future__ import annotations
+
+from ..ndarray import ops as _ops
+from ..ndarray.ndarray import NDArray
+from ..numpy import ndarray as np_ndarray, from_nd
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "use_np_array", "np_array"]
+
+_NP_ARRAY = False
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Enable NumPy semantics globally (reference ``mx.npx.set_np``).
+    In the rebuild np-shape (zero-dim/unknown-dim) is always on — jax
+    has true numpy shape semantics natively — so only the array flag is
+    tracked."""
+    global _NP_ARRAY
+    _NP_ARRAY = bool(array)
+
+
+def reset_np():
+    set_np(array=False)
+
+
+def is_np_array() -> bool:
+    return _NP_ARRAY
+
+
+def is_np_shape() -> bool:
+    return True
+
+
+class np_array:
+    """Context manager / decorator enabling np-array mode."""
+
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        global _NP_ARRAY
+        self._prev = _NP_ARRAY
+        _NP_ARRAY = self._active
+        return self
+
+    def __exit__(self, *exc):
+        global _NP_ARRAY
+        _NP_ARRAY = self._prev
+
+
+use_np_array = np_array
+
+
+def _to_np(out):
+    if isinstance(out, tuple):
+        return tuple(_to_np(o) for o in out)
+    if isinstance(out, NDArray) and not isinstance(out, np_ndarray):
+        return from_nd(out)
+    return out
+
+
+def __getattr__(name):
+    fn = _ops.OP_REGISTRY.get(name)
+    if fn is None:
+        # npx uses lowercase names for several ops the registry
+        # capitalizes (npx.batch_norm → BatchNorm is already aliased)
+        raise AttributeError(f"module 'mxtpu.numpy_extension' has no "
+                             f"attribute {name!r}")
+
+    def npx_fn(*args, **kwargs):
+        return _to_np(fn(*args, **kwargs))
+
+    npx_fn.__name__ = name
+    globals()[name] = npx_fn
+    return npx_fn
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_ops.OP_REGISTRY)))

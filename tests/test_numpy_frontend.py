@@ -1,0 +1,186 @@
+"""mx.np / mx.npx tests (reference tests/python/unittest/test_numpy_op.py
+patterns — NumPy is ground truth)."""
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd
+
+np = mx.np
+npx = mx.npx
+
+
+def test_array_creation_and_dtype():
+    a = np.array([1.0, 2.0, 3.0])
+    assert a.dtype == onp.float32          # float64 demotes
+    b = np.array([1, 2, 3])
+    assert b.dtype in (onp.int32, onp.int64)
+    assert isinstance(a, np.ndarray)
+    z = np.zeros((2, 3))
+    assert z.shape == (2, 3)
+    e = np.eye(3)
+    onp.testing.assert_allclose(e.asnumpy(), onp.eye(3))
+    li = np.linspace(0, 1, 5)
+    onp.testing.assert_allclose(li.asnumpy(), onp.linspace(0, 1, 5),
+                                rtol=1e-6)
+
+
+def test_numpy_semantics_comparisons():
+    a = np.array([1.0, 2.0, 3.0])
+    m = a > 2.0
+    assert m.dtype == onp.bool_            # numpy frontend: bool results
+    assert m.asnumpy().tolist() == [False, False, True]
+    # mx.nd keeps float masks (legacy semantics) — both frontends coexist
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    assert (x > 2.0).dtype == onp.float32
+
+
+def test_function_namespace_matches_numpy():
+    rng = onp.random.default_rng(0)
+    a = rng.standard_normal((3, 4)).astype(onp.float32)
+    b = rng.standard_normal((4, 5)).astype(onp.float32)
+    onp.testing.assert_allclose(np.dot(np.array(a), np.array(b)).asnumpy(),
+                                onp.dot(a, b), rtol=1e-5)
+    onp.testing.assert_allclose(np.tanh(np.array(a)).asnumpy(),
+                                onp.tanh(a), rtol=1e-6)
+    onp.testing.assert_allclose(
+        np.concatenate([np.array(a), np.array(a)], axis=0).asnumpy(),
+        onp.concatenate([a, a], axis=0))
+    onp.testing.assert_allclose(np.sum(np.array(a), axis=1).asnumpy(),
+                                a.sum(axis=1), rtol=1e-6)
+    out = np.split(np.array(a), 2, axis=1)
+    assert len(out) == 2 and out[0].shape == (3, 2)
+    onp.testing.assert_allclose(
+        np.where(np.array(a) > 0, np.array(a), np.zeros(a.shape)).asnumpy(),
+        onp.where(a > 0, a, 0), rtol=1e-6)
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", np.array(a), np.array(b)).asnumpy(),
+        onp.einsum("ij,jk->ik", a, b), rtol=1e-5)
+
+
+def test_ndarray_methods():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.T.shape == (2, 2)
+    onp.testing.assert_allclose(a.std().asnumpy(),
+                                onp.std([[1, 2], [3, 4]]), rtol=1e-6)
+    assert bool((a > 0).all())
+    assert not bool((a > 3.5).all())
+    assert a.reshape(4).shape == (4,)
+    assert a.item(0) == 1.0
+
+
+def test_autograd_through_np():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    a.attach_grad()
+    with autograd.record():
+        y = np.sum(np.tanh(a) * 2.0)
+    y.backward()
+    expected = 2.0 * (1 - onp.tanh([[1, 2], [3, 4]]) ** 2)
+    onp.testing.assert_allclose(a.grad.asnumpy(), expected, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_class_propagation_through_registry_ops():
+    a = np.array([[1.0, -2.0]])
+    out = npx.relu(a)
+    assert isinstance(out, np.ndarray)
+    onp.testing.assert_allclose(out.asnumpy(), [[1.0, 0.0]])
+    s = npx.softmax(a, axis=-1)
+    assert isinstance(s, np.ndarray)
+    onp.testing.assert_allclose(s.asnumpy().sum(), 1.0, rtol=1e-6)
+
+
+def test_npx_mode_flags():
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array()
+    npx.reset_np()
+    assert not npx.is_np_array()
+    with npx.np_array(True):
+        assert npx.is_np_array()
+    assert not npx.is_np_array()
+    assert npx.is_np_shape()
+
+
+def test_np_random():
+    np.random.seed(0)
+    u = np.random.uniform(0, 1, size=(1000,))
+    assert isinstance(u, np.ndarray)
+    assert 0.4 < float(u.asnumpy().mean()) < 0.6
+    n = np.random.normal(5.0, 0.1, size=(1000,))
+    assert 4.9 < float(n.asnumpy().mean()) < 5.1
+    r = np.random.randint(0, 10, size=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    c = np.random.choice(5, size=(50,))
+    assert c.shape == (50,)
+    x = np.arange(10)
+    np.random.shuffle(x)
+    assert sorted(x.asnumpy().tolist()) == list(range(10))
+
+
+def test_interop_nd_np():
+    x = mx.nd.array([[1.0, 2.0]])
+    xnp = np.array(x)
+    assert isinstance(xnp, np.ndarray)
+    back = xnp.as_nd_ndarray()
+    assert type(back) is mx.nd.NDArray
+    onp.testing.assert_allclose(back.asnumpy(), [[1.0, 2.0]])
+
+
+def test_class_survives_copy_detach_like():
+    a = np.array([1.0, 2.0])
+    for b in (a.copy(), a.detach(), a.zeros_like(), a.ones_like(),
+              a.as_in_context(mx.cpu())):
+        assert isinstance(b, np.ndarray), type(b)
+    assert isinstance((a.copy() > 1.5), np.ndarray)
+    assert (a.copy() > 1.5).dtype == onp.bool_
+
+
+def test_compare_with_none():
+    a = np.array([1.0])
+    assert (a == None).asnumpy().tolist() == [False]   # noqa: E711
+    assert (a != None).asnumpy().tolist() == [True]    # noqa: E711
+
+
+def test_host_value_functions():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert np.ndim(a) == 2
+    assert np.shape(a) == (2, 2)
+    assert np.size(a) == 4
+
+
+def test_linalg_and_fft_proxies():
+    a = np.array([[2.0, 0.0], [0.0, 3.0]])
+    n = np.linalg.norm(np.array([3.0, 4.0]))
+    onp.testing.assert_allclose(float(n), 5.0, rtol=1e-6)
+    det = np.linalg.det(a)
+    onp.testing.assert_allclose(float(det), 6.0, rtol=1e-6)
+    w, v = np.linalg.eigh(a)
+    assert isinstance(w, np.ndarray) and isinstance(v, np.ndarray)
+    f = np.fft.fft(np.array([1.0, 0.0, 0.0, 0.0]))
+    assert f.shape == (4,)
+    # autograd flows through the proxy
+    x = np.array([3.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.linalg.norm(x)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.6, 0.8], rtol=1e-5)
+
+
+def test_nonzero_data_dependent():
+    a = np.array([0.0, 1.0, 0.0, 2.0])
+    (idx,) = np.nonzero(a)
+    assert idx.asnumpy().tolist() == [1, 3]
+
+
+def test_grad_shared_across_views():
+    a = mx.nd.array([1.0, 2.0])
+    a.attach_grad()
+    b = np.array([0.0])  # touch module
+    v = mx.np.from_nd(a)
+    with autograd.record():
+        y = (v * v).sum()
+    y.backward()
+    assert v.grad is a.grad
+    onp.testing.assert_allclose(a.grad.asnumpy(), [2.0, 4.0])
