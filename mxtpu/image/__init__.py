@@ -1,0 +1,17 @@
+"""mx.image (reference ``python/mxnet/image/image.py`` [path cite —
+unverified]): decode / resize / augment / iterate over images.
+
+Codec: TensorFlow's native JPEG/PNG codecs (the only C++ image codec in
+this environment — the reference used OpenCV/libjpeg-turbo). Resizing
+and color math run in jax (TPU-offloadable) or numpy; the augmenter API
+(``CreateAugmenter`` + callable augmenter objects) matches the
+reference so training scripts port unchanged.
+"""
+from .image import *         # noqa: F401,F403
+from .image import (imdecode, imencode, imread, imresize, resize_short,
+                    fixed_crop, random_crop, center_crop, color_normalize,
+                    CreateAugmenter, Augmenter, ResizeAug, ForceResizeAug,
+                    RandomCropAug, CenterCropAug, HorizontalFlipAug,
+                    CastAug, ColorNormalizeAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, ColorJitterAug,
+                    LightingAug, RandomSizedCropAug, ImageIter)

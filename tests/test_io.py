@@ -1,0 +1,300 @@
+"""IO tests (reference tests/python/unittest/test_io.py + test_recordio
+patterns: NDArrayIter semantics, RecordIO byte format, image pipeline)."""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import io as mio
+from mxtpu import recordio
+
+
+def test_ndarrayiter_basic():
+    data = onp.arange(20, dtype=onp.float32).reshape(10, 2)
+    label = onp.arange(10, dtype=onp.float32)
+    it = mio.NDArrayIter(data, label, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2)
+    assert batches[-1].pad == 2
+    # pad wraps to the head
+    onp.testing.assert_allclose(batches[-1].data[0].asnumpy()[2:],
+                                data[:2])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarrayiter_discard_and_shuffle():
+    data = onp.arange(10, dtype=onp.float32).reshape(10, 1)
+    it = mio.NDArrayIter(data, None, batch_size=3,
+                         last_batch_handle="discard", shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    seen = onp.concatenate([b.data[0].asnumpy().ravel() for b in batches])
+    assert len(set(seen.tolist())) == 9
+
+
+def test_ndarrayiter_dict_inputs():
+    it = mio.NDArrayIter({"a": onp.zeros((6, 2)), "b": onp.ones((6, 3))},
+                         {"softmax_label": onp.arange(6)}, batch_size=2)
+    assert [d.name for d in it.provide_data] == ["a", "b"]
+    assert it.provide_data[0].shape == (2, 2)
+    b = next(it)
+    assert b.data[1].shape == (2, 3)
+
+
+def test_csviter(tmp_path):
+    data = onp.random.default_rng(0).standard_normal((8, 3)).astype(
+        onp.float32)
+    labels = onp.arange(8, dtype=onp.float32)
+    dpath = str(tmp_path / "d.csv")
+    lpath = str(tmp_path / "l.csv")
+    onp.savetxt(dpath, data, delimiter=",")
+    onp.savetxt(lpath, labels, delimiter=",")
+    it = mio.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                     batch_size=4)
+    b = next(it)
+    onp.testing.assert_allclose(b.data[0].asnumpy(), data[:4], rtol=1e-5)
+    onp.testing.assert_allclose(b.label[0].asnumpy(), labels[:4])
+
+
+def test_libsvmiter(tmp_path):
+    p = str(tmp_path / "data.svm")
+    with open(p, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0\n")
+    it = mio.LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=3,
+                        round_batch=False)
+    b = next(it)
+    onp.testing.assert_allclose(
+        b.data[0].asnumpy(),
+        [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0], [0, 0, 3.0, 0]])
+    onp.testing.assert_allclose(b.label[0].asnumpy(), [1, 0, 1])
+
+
+def test_recordio_round_trip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode() * (i + 1))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == f"record-{i}".encode() * (i + 1)
+    assert r.read() is None
+    # byte-format check: magic + length of first record
+    with open(path, "rb") as f:
+        magic, lrec = struct.unpack("<II", f.read(8))
+    assert magic == 0xced7230a
+    assert (lrec & ((1 << 29) - 1)) == len(b"record-0")
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idxp = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i in range(10):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idxp, path, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"rec7"
+    assert r.read_idx(2) == b"rec2"
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 42
+    # array label
+    h = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = recordio.pack(h, b"x")
+    h2, payload = recordio.unpack(s)
+    assert h2.flag == 3
+    onp.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"x"
+
+
+@pytest.fixture(scope="module")
+def image_rec(tmp_path_factory):
+    """Synthetic 4-class image .rec built via pack_img."""
+    tmp = tmp_path_factory.mktemp("imgrec")
+    path = str(tmp / "data.rec")
+    idxp = str(tmp / "data.idx")
+    rng = onp.random.default_rng(0)
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i in range(12):
+        img = rng.integers(0, 255, (24, 32, 3), dtype=onp.uint8)
+        header = recordio.IRHeader(0, float(i % 4), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, quality=90))
+    w.close()
+    return path
+
+
+def test_pack_unpack_img(image_rec):
+    r = recordio.MXRecordIO(image_rec, "r")
+    header, img = recordio.unpack_img(r.read())
+    assert img.shape == (24, 32, 3)
+    assert header.label == 0.0
+
+
+def test_image_record_iter(image_rec):
+    it = mio.ImageRecordIter(path_imgrec=image_rec, data_shape=(3, 16, 16),
+                             batch_size=4, shuffle=True,
+                             mean_r=123.0, mean_g=117.0, mean_b=104.0)
+    n = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 16, 16)
+        labels.extend(batch.label[0].asnumpy().tolist())
+        n += 1
+    assert n == 3
+    assert set(labels) <= {0.0, 1.0, 2.0, 3.0}
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_imdecode_imresize():
+    from mxtpu import image as mimg
+    rng = onp.random.default_rng(1)
+    img = rng.integers(0, 255, (20, 30, 3), dtype=onp.uint8)
+    buf = mimg.imencode(img, ".png")          # png is lossless
+    dec = mimg.imdecode(buf, as_numpy=True)
+    onp.testing.assert_array_equal(dec, img)
+    small = mimg.imresize(mx.nd.array(img, dtype="uint8"), 15, 10)
+    assert small.shape == (10, 15, 3)
+    rs = mimg.resize_short(mx.nd.array(img, dtype="uint8"), 10)
+    assert min(rs.shape[:2]) == 10
+
+
+def test_augmenters():
+    from mxtpu import image as mimg
+    img = mx.nd.array(onp.random.default_rng(2).integers(
+        0, 255, (40, 40, 3)).astype(onp.float32))
+    augs = mimg.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                rand_mirror=True, mean=True, std=True,
+                                brightness=0.1)
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == onp.float32
+
+
+def test_prefetching_iter():
+    data = onp.arange(40, dtype=onp.float32).reshape(20, 2)
+    base = mio.NDArrayIter(data, None, batch_size=5)
+    it = mio.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_resize_iter():
+    data = onp.arange(20, dtype=onp.float32).reshape(10, 2)
+    base = mio.NDArrayIter(data, None, batch_size=5)
+    it = mio.ResizeIter(base, 5)
+    assert len(list(it)) == 5
+
+
+def test_im2rec_tool(tmp_path):
+    from mxtpu import image as mimg
+    rng = onp.random.default_rng(3)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = rng.integers(0, 255, (16, 16, 3), dtype=onp.uint8)
+            with open(d / f"{i}.jpg", "wb") as f:
+                f.write(mimg.imencode(img, ".jpg"))
+    root = str(tmp_path / "imgs")
+    prefix = str(tmp_path / "ds")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "im2rec.py")
+    subprocess.run([sys.executable, tool, prefix, root, "--list",
+                    "--recursive"], check=True)
+    assert os.path.exists(prefix + ".lst")
+    subprocess.run([sys.executable, tool, prefix, root], check=True)
+    assert os.path.exists(prefix + ".rec")
+    it = mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                             data_shape=(3, 16, 16), batch_size=2)
+    batch = next(it)
+    assert batch.data[0].shape == (2, 3, 16, 16)
+
+
+def test_ndarrayiter_roll_over():
+    data = onp.arange(10, dtype=onp.float32).reshape(10, 1)
+    it = mio.NDArrayIter(data, None, batch_size=4,
+                         last_batch_handle="roll_over")
+    b1 = list(it)
+    # only whole batches this epoch; tail (8,9) rolls over
+    assert len(b1) == 2
+    assert all(b.pad == 0 for b in b1)
+    it.reset()
+    b2 = list(it)
+    # rolled batch first: tail of previous epoch + new head, full, pad 0
+    assert len(b2) == 3
+    onp.testing.assert_allclose(b2[0].data[0].asnumpy().ravel(),
+                                [8, 9, 0, 1])
+    assert b2[0].pad == 0
+
+
+def test_recordio_multipart_read(tmp_path):
+    # dmlc splits payloads containing the aligned magic into cflag
+    # 1/2/3 chunks; reader must reassemble
+    path = str(tmp_path / "mp.rec")
+    magic = struct.pack("<I", 0xced7230a)
+    part_a, part_b = b"abcd", b"efgh1234"
+    with open(path, "wb") as f:
+        def chunk(cflag, payload):
+            f.write(struct.pack("<II", 0xced7230a,
+                                (cflag << 29) | len(payload)))
+            f.write(payload)
+            f.write(b"\x00" * ((-len(payload)) % 4))
+        chunk(1, part_a)
+        chunk(3, part_b)
+        chunk(0, b"plain")
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == part_a + magic + part_b
+    assert r.read() == b"plain"
+
+
+def test_recordio_writer_fork_guard(tmp_path):
+    import multiprocessing
+    path = str(tmp_path / "w.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"first")
+
+    def child(rec, q):
+        try:
+            rec.write(b"child")
+            q.put("wrote")
+        except Exception as e:
+            q.put(type(e).__name__)
+
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=child, args=(w, q))
+    p.start()
+    p.join()
+    assert q.get() == "MXNetError"
+    w.write(b"second")
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == b"first"
+    assert r.read() == b"second"
+
+
+def test_prefetching_iter_repeated_exhaustion():
+    data = onp.arange(8, dtype=onp.float32).reshape(8, 1)
+    it = mio.PrefetchingIter(mio.NDArrayIter(data, None, batch_size=4))
+    assert len(list(it)) == 2
+    assert len(list(it)) == 0     # raises StopIteration again, no hang
+    it.reset()
+    assert len(list(it)) == 2
