@@ -43,12 +43,17 @@ def __getattr__(name):
             "callback", "kvstore", "io", "image", "symbol", "profiler",
             "test_utils", "util", "runtime", "recordio", "np", "npx",
             "sym", "model", "engine", "parallel", "models", "ops",
-            "utils", "amp", "contrib", "rnn", "serde"}
+            "utils", "amp", "contrib", "rnn", "serde", "module", "mod"}
     if name in lazy:
         mod = {"sym": "mxtpu.symbol", "np": "mxtpu.numpy",
                "npx": "mxtpu.numpy_extension",
-               "rnn": "mxtpu.gluon.rnn"}.get(name, f"mxtpu.{name}")
-        m = importlib.import_module(mod)
+               "rnn": "mxtpu.gluon.rnn",
+               "mod": "mxtpu.module"}.get(name, f"mxtpu.{name}")
+        try:
+            m = importlib.import_module(mod)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'mxtpu' has no attribute {name!r}") from e
         globals()[name] = m
         return m
     if name == "kv":

@@ -378,6 +378,11 @@ class ImageIter:
                 self.record = MXIndexedRecordIO(idx_path, path_imgrec, "r")
                 self.seq = list(self.record.keys)
             else:
+                if shuffle:
+                    raise MXNetError(
+                        "shuffle=True needs random access: build the "
+                        f"{idx_path} sidecar (tools/im2rec.py) or pass "
+                        "shuffle=False")
                 self.record = MXRecordIO(path_imgrec, "r")
                 self.seq = None
         elif path_imglist is not None:

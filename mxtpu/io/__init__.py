@@ -142,7 +142,7 @@ class NDArrayIter(DataIter):
         self.last_batch_handle = last_batch_handle
         self.idx = onp.arange(self.num_data)
         self.cursor = -batch_size
-        self._cache_idx = None
+        self._rolled = None          # undelivered tail (roll_over mode)
         self.reset()
 
     @property
@@ -156,14 +156,20 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        roll = self.last_batch_handle == "roll_over" and \
+            0 < self.cursor < self.num_data
+        if roll:
+            # capture the undelivered tail BEFORE reshuffling, so the
+            # rolled batch serves exactly the held-over samples
+            self._rolled = self.idx[self.cursor:].copy()
         if self.shuffle:
             onp.random.shuffle(self.idx)
-        if self.last_batch_handle == "roll_over" and \
-                0 < self.cursor < self.num_data:
+        if roll:
             # tail of this epoch rolls into the next epoch's first batch
-            # (cursor goes negative; _take wraps tail + new head)
-            self.cursor = self.cursor - self.num_data - self.batch_size
+            # (cursor goes negative; _take pulls from _rolled + new head)
+            self.cursor = -len(self._rolled) - self.batch_size
         else:
+            self._rolled = None
             self.cursor = -self.batch_size
 
     def iter_next(self) -> bool:
@@ -181,8 +187,8 @@ class NDArrayIter(DataIter):
         out = []
         for _, v in arrays:
             if lo < 0:   # roll_over: previous epoch's tail + new head
-                sel = onp.concatenate([self.idx[lo:], self.idx[:hi]]) \
-                    if hi > 0 else self.idx[lo:]
+                sel = onp.concatenate([self._rolled, self.idx[:hi]]) \
+                    if hi > 0 else self._rolled
             elif hi <= self.num_data:
                 sel = self.idx[lo:hi]
             else:        # pad: wrap around from the head

@@ -613,11 +613,15 @@ def softmin(data, axis=-1, **kwargs):
 
 @register_op("SoftmaxOutput", aliases=("softmax_output",))
 def SoftmaxOutput(data, label=None, grad_scale=1.0, ignore_label=-1,
-                  use_ignore=False, multi_output=False, **kwargs):
+                  use_ignore=False, multi_output=False,
+                  normalization="null", **kwargs):
     """Legacy combined softmax + cross-entropy-gradient op (reference
     src/operator/softmax_output.cc): forward is softmax; backward IGNORES
     the incoming head gradient and injects (softmax - one_hot(label)) *
-    grad_scale, exactly like the reference's hard-coded backward."""
+    grad_scale, exactly like the reference's hard-coded backward.
+    ``normalization``: 'null' (sum over batch, reference default),
+    'batch' (divide by batch size), 'valid' (divide by non-ignored
+    count)."""
     if label is None:
         return softmax(data, axis=-1)
     axis = 1 if multi_output else -1
@@ -640,6 +644,15 @@ def SoftmaxOutput(data, label=None, grad_scale=1.0, ignore_label=-1,
             mask = (l != ignore_label).astype(out.dtype)
             mask = jnp.expand_dims(mask, axis)
             gx = gx * mask
+        if normalization == "batch":
+            gx = gx / out.shape[0]
+        elif normalization == "valid":
+            if use_ignore:
+                cnt = jnp.maximum(
+                    jnp.sum((l != ignore_label).astype(out.dtype)), 1.0)
+            else:
+                cnt = jnp.asarray(float(l.size), out.dtype)
+            gx = gx / cnt
         return gx, jnp.zeros_like(l)
 
     _so.defvjp(_so_fwd, _so_bwd)

@@ -74,9 +74,17 @@ class MXRecordIO:
     def __setstate__(self, d):
         self.__dict__.update(d)
         if not self.is_open:
-            self.open()
-            if self.flag == "r":
-                pass
+            if self.flag == "w":
+                # re-opening 'wb' would truncate what was already
+                # written; continue the stream instead
+                self.record = open(self.uri, "ab")
+                self.writable = True
+                if hasattr(self, "idx_path"):
+                    self.fidx = open(self.idx_path, "a")
+                self.pid = os.getpid()
+                self.is_open = True
+            else:
+                self.open()
 
     def _check_pid(self, allow_reset: bool = True):
         # after fork (DataLoader workers) the fd must be reopened — but

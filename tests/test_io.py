@@ -298,3 +298,46 @@ def test_prefetching_iter_repeated_exhaustion():
     assert len(list(it)) == 0     # raises StopIteration again, no hang
     it.reset()
     assert len(list(it)) == 2
+
+
+def test_roll_over_with_shuffle_serves_heldover_samples():
+    data = onp.arange(10, dtype=onp.float32).reshape(10, 1)
+    it = mio.NDArrayIter(data, None, batch_size=4, shuffle=True,
+                         last_batch_handle="roll_over")
+    first = [b.data[0].asnumpy().ravel() for b in it]
+    served = set(onp.concatenate(first).tolist())
+    heldover = set(range(10)) - served
+    assert len(heldover) == 2
+    it.reset()
+    rolled = next(it).data[0].asnumpy().ravel()
+    # the rolled batch starts with exactly the held-over samples
+    assert set(rolled[:2].tolist()) == heldover
+
+
+def test_recordio_writer_pickle_appends(tmp_path):
+    import pickle
+    path = str(tmp_path / "p.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"one")
+    w.record.flush()
+    w2 = pickle.loads(pickle.dumps(w))
+    w2.write(b"two")
+    w2.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == b"one"
+    assert r.read() == b"two"
+
+
+def test_imageiter_shuffle_without_idx_raises(tmp_path):
+    from mxtpu.base import MXNetError
+    path = str(tmp_path / "noidx.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0), b"x"))
+    w.close()
+    from mxtpu.image import ImageIter
+    with pytest.raises(MXNetError):
+        ImageIter(1, (3, 8, 8), path_imgrec=path, shuffle=True)
+
+
+def test_missing_attr_is_attribute_error():
+    assert not hasattr(mx, "definitely_not_a_module")

@@ -1,0 +1,165 @@
+"""Module tests (reference tests/python/unittest/test_module.py +
+tests/python/train convergence patterns)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import io as mio
+from mxtpu import metric as mmetric
+
+sym = mx.sym
+
+
+def _mlp_symbol(hidden=32, classes=4):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax", normalization="batch")
+
+
+def _blob_data(n=200, dim=8, classes=4, seed=0):
+    rng = onp.random.default_rng(seed)
+    centers = rng.standard_normal((classes, dim)) * 3
+    labels = rng.integers(0, classes, n)
+    data = centers[labels] + rng.standard_normal((n, dim)) * 0.5
+    return data.astype(onp.float32), labels.astype(onp.float32)
+
+
+def test_module_bind_and_forward():
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 8))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params()
+    batch = mio.DataBatch(data=[mx.nd.ones((10, 8))],
+                          label=[mx.nd.zeros((10,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (10, 4)
+    onp.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                                onp.ones(10), rtol=1e-5)
+
+
+def test_module_fit_converges():
+    """tests/python/train analogue: fit a small MLP, check accuracy."""
+    data, labels = _blob_data()
+    train_iter = mio.NDArrayIter(data, labels, batch_size=20, shuffle=True)
+    val_iter = mio.NDArrayIter(data, labels, batch_size=20)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train_iter, eval_data=val_iter, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),),
+            eval_metric="acc", num_epoch=10)
+    score = mod.score(val_iter, "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.initializer.Normal(0.1))
+    args, auxs = mod.get_params()
+    assert set(args) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    mod2 = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (4, 8))],
+              label_shapes=[("softmax_label", (4,))])
+    mod2.set_params(args, auxs)
+    x = mio.DataBatch(data=[mx.nd.ones((4, 8))], label=[mx.nd.zeros((4,))])
+    mod.forward(x, is_train=False)
+    mod2.forward(x, is_train=False)
+    onp.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                                mod2.get_outputs()[0].asnumpy(), rtol=1e-6)
+
+
+def test_module_checkpoint_round_trip(tmp_path):
+    data, labels = _blob_data(80)
+    train_iter = mio.NDArrayIter(data, labels, batch_size=16)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train_iter, optimizer_params=(("learning_rate", 0.3),),
+            num_epoch=3)
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (16, 8))],
+              label_shapes=[("softmax_label", (16,))])
+    mod2.init_params()
+    b = mio.DataBatch(data=[mx.nd.array(data[:16])],
+                      label=[mx.nd.array(labels[:16])])
+    mod.forward(b, is_train=False)
+    mod2.forward(b, is_train=False)
+    onp.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                                mod2.get_outputs()[0].asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_module_predict_and_input_grads():
+    data, labels = _blob_data(40)
+    it = mio.NDArrayIter(data, labels, batch_size=16)  # pads last batch
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             inputs_need_grad=True)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (40, 4)          # pad stripped
+    it.reset()
+    batch = next(it)
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()[0]
+    assert ig.shape == (16, 8)
+    assert float(ig.abs().sum()) > 0
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        # per-step shared projection over (N, T, F): weights don't
+        # depend on the bucket length, like the reference's RNN buckets
+        data = sym.var("data")
+        net = sym.FullyConnected(data, num_hidden=8, flatten=False,
+                                 name="fc_shared")
+        net = sym.sum(net, axis=1)
+        net = sym.FullyConnected(net, num_hidden=2, name="out")
+        return sym.SoftmaxOutput(net, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10, 3))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    from mxtpu.io import DataBatch, DataDesc
+    b10 = DataBatch(data=[mx.nd.ones((4, 10, 3))],
+                    label=[mx.nd.zeros((4,))], bucket_key=10,
+                    provide_data=[DataDesc("data", (4, 10, 3))],
+                    provide_label=[DataDesc("softmax_label", (4,))])
+    mod.forward(b10, is_train=True)
+    mod.backward()
+    mod.update()
+    # switch to another bucket; shared fc weight persists
+    b5 = DataBatch(data=[mx.nd.ones((4, 5, 3))],
+                   label=[mx.nd.zeros((4,))], bucket_key=5,
+                   provide_data=[DataDesc("data", (4, 5, 3))],
+                   provide_label=[DataDesc("softmax_label", (4,))])
+    mod.forward(b5, is_train=True)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 2)
+    args, _ = mod.get_params()
+    assert "out_weight" in args
+
+
+def test_score_with_composite_metric():
+    data, labels = _blob_data(60)
+    it = mio.NDArrayIter(data, labels, batch_size=20)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    comp = mmetric.CompositeEvalMetric()
+    comp.add(mmetric.Accuracy())
+    comp.add(mmetric.CrossEntropy())
+    res = dict(mod.score(it, comp))
+    assert "accuracy" in res and "cross-entropy" in res
