@@ -43,7 +43,8 @@ def __getattr__(name):
             "callback", "kvstore", "io", "image", "symbol", "profiler",
             "test_utils", "util", "runtime", "recordio", "np", "npx",
             "sym", "model", "engine", "parallel", "models", "ops",
-            "utils", "amp", "contrib", "rnn", "serde", "module", "mod"}
+            "utils", "amp", "contrib", "rnn", "serde", "module", "mod",
+            "monitor"}
     if name in lazy:
         mod = {"sym": "mxtpu.symbol", "np": "mxtpu.numpy",
                "npx": "mxtpu.numpy_extension",

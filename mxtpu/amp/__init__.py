@@ -1,0 +1,139 @@
+"""AMP — automatic mixed precision (reference
+``python/mxnet/contrib/amp/`` + ``src/nnvm/low_precision_pass.cc``
+[path cites — unverified]).
+
+TPU-native stance: the fast dtype is **bfloat16**, which shares
+float32's exponent range — so dynamic loss scaling is unnecessary on
+the default path (it exists for float16 parity). Where the reference
+rewrote the graph with amp_cast nodes around an allow/deny op list,
+here casting the inputs/params is enough: XLA propagates and fuses the
+converts.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "convert_model", "LossScaler",
+           "amp_cast", "amp_multicast"]
+
+_TARGET_DTYPE: Optional[str] = None
+
+# layers whose params/compute must stay f32 (the reference's FP32 deny
+# list: batchnorm & friends accumulate)
+_KEEP_FP32_BLOCKS = ("batchnorm", "layernorm", "instancenorm", "groupnorm")
+
+
+def init(target_dtype: str = "bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (reference ``amp.init``). Records the target dtype used
+    by convert_* and init_trainer."""
+    global _TARGET_DTYPE
+    if target_dtype not in ("bfloat16", "float16"):
+        raise ValueError("target_dtype must be bfloat16 or float16")
+    _TARGET_DTYPE = target_dtype
+
+
+def _target():
+    if _TARGET_DTYPE is None:
+        raise MXNetError("call amp.init() first")
+    return _TARGET_DTYPE
+
+
+def convert_hybrid_block(block, target_dtype: Optional[str] = None,
+                         cast_optional_params: bool = False):
+    """Cast a Gluon block to mixed precision in place + return it:
+    all params → target dtype except normalization layers (reference
+    ``amp.convert_hybrid_block``)."""
+    target = target_dtype or _target()
+
+    import numpy as _np
+
+    def _cast(b):
+        name = type(b).__name__.lower()
+        if any(k in name for k in _KEEP_FP32_BLOCKS):
+            return
+        for p in b._reg_params.values():
+            if _np.dtype(p.dtype).kind == "f":
+                p.cast(target)
+    block.apply(_cast)
+    return block
+
+
+def convert_model(sym, arg_params, aux_params,
+                  target_dtype: Optional[str] = None, **kwargs):
+    """Symbolic conversion (reference ``amp.convert_model``): cast arg
+    params to the target dtype (aux/BN stats stay f32); the symbol is
+    unchanged — ops compute in their input dtype and XLA inserts the
+    converts the reference's amp_cast nodes expressed."""
+    target = target_dtype or _target()
+    new_args = {}
+    for k, v in arg_params.items():
+        new_args[k] = v.astype(target) if v.dtype.kind == "f" and \
+            not k.endswith(("gamma", "beta")) else v
+    return sym, new_args, dict(aux_params)
+
+
+def init_trainer(trainer):
+    """Attach a dynamic LossScaler to a Trainer (reference
+    ``amp.init_trainer``); no-op scale for bfloat16."""
+    scaler = LossScaler(
+        init_scale=1.0 if _target() == "bfloat16" else 2 ** 16)
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``
+    — scales the loss up and arranges for Trainer.step to scale grads
+    back down (reference ``amp.scale_loss``)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Check grads for inf/nan and unscale them eagerly (reference
+    ``amp.unscale``). Returns True if grads are finite."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    params = [p for p in trainer._params
+              if p.grad_req != "null" and p._data is not None]
+    grads = [p.grad() for p in params]
+    # grads carry the scale active during backward — capture it before
+    # has_overflow() adjusts the scaler for the NEXT step
+    applied_scale = scaler.loss_scale
+    finite = scaler.has_overflow(grads) is False
+    if finite and applied_scale != 1.0:
+        for g in grads:
+            g._set_data(g._data / applied_scale)
+        trainer._scale = trainer._amp_original_scale
+    return finite
+
+
+def amp_cast(data, dtype="bfloat16"):
+    """Cast op (reference amp_cast node)."""
+    return data.astype(dtype)
+
+
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    """Cast a set of arrays to a common dtype (reference amp_multicast):
+    widest by default, narrowest with ``cast_narrow``."""
+    import numpy as _np
+    dtypes = [d.dtype for d in data]
+    key = min if cast_narrow else max
+    target = key(dtypes, key=lambda dt: _np.dtype(dt).itemsize)
+    return [d.astype(target) for d in data]

@@ -1,0 +1,40 @@
+"""Dynamic loss scaler (reference
+``python/mxnet/contrib/amp/loss_scaler.py`` [path cite — unverified]):
+double the scale every ``scale_window`` clean steps, halve on overflow.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale: float = 2 ** 16,
+                 scale_factor: float = 2.0, scale_window: int = 2000,
+                 min_scale: float = 1.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._min_scale = min_scale
+        self._unskipped = 0
+
+    def has_overflow(self, grads) -> bool:
+        """Check grads for inf/nan and update the scale (reference
+        LossScaler.has_overflow + update_scale)."""
+        overflow = False
+        for g in grads:
+            data = g._data if hasattr(g, "_data") else g
+            if not bool(jnp.isfinite(data).all()):
+                overflow = True
+                break
+        if overflow:
+            self.loss_scale = max(self._min_scale,
+                                  self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+        return overflow

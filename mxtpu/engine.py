@@ -1,0 +1,30 @@
+"""mx.engine (reference ``python/mxnet/engine.py``): execution-engine
+knobs. The ThreadedEngine's bulking (batching op pushes into one engine
+segment) maps to XLA fusion under jit — the bulk-size knobs are accepted
+and recorded for API parity; the NaiveEngine debug mode (sync after
+every op) is honored via MXNET_ENGINE_TYPE, as in the reference."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_BULK_SIZE = 15
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the engine bulk size; returns the previous value (reference
+    ``mx.engine.set_bulk_size``)."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """Scope with a given bulk size (reference ``mx.engine.bulk``)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

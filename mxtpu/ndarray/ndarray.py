@@ -33,6 +33,10 @@ __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
 
 _NAIVE = env_str("MXNET_ENGINE_TYPE", "ThreadedEngine") == "NaiveEngine"
 
+# installed by mxtpu.profiler when profiling: fn(op_name, dispatch_secs)
+_profile_hook = None
+from time import perf_counter as _perf_counter  # noqa: E402
+
 
 def _parents_of(arrays) -> List[Any]:
     """Tape parent descriptor for each NDArray input (None for constants)."""
@@ -60,7 +64,10 @@ def apply_op(raw_fn: Callable, arrays: Sequence["NDArray"], name: str = "",
     """
     parents = _parents_of(arrays)
     datas = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    t0 = _perf_counter() if _profile_hook is not None else None
     out, node = autograd.invoke(raw_fn, datas, parents, name)
+    if t0 is not None:
+        _profile_hook(name, _perf_counter() - t0)
     # results take the class of the first array input, so mx.np arrays
     # (NDArray subclass with numpy semantics) propagate through every op
     cls = next((type(a) for a in arrays if isinstance(a, NDArray)), NDArray)

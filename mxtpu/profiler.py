@@ -1,0 +1,180 @@
+"""mx.profiler (reference ``python/mxnet/profiler.py`` over
+``src/profiler/profiler.cc`` [path cites — unverified]).
+
+Two layers, mirroring the reference's engine-hook + chrome-trace design:
+
+1. **XLA/TPU trace** — ``start()/stop()`` drive ``jax.profiler`` and
+   write a TensorBoard-loadable trace (the reference wrote chrome://
+   tracing JSON; XLA's trace contains true per-op device timings).
+2. **Python-level op log** — when enabled, every ``apply_op`` dispatch
+   is counted (op name, count, host dispatch time), giving the
+   reference's ``aggregate_stats`` table (``dumps()``) without device
+   sync.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump",
+           "dumps", "set_state", "Marker", "Counter", "Task", "Frame"]
+
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "aggregate_stats": False}
+_state = {"running": False, "trace_dir": None}
+_agg: Dict[str, list] = defaultdict(lambda: [0, 0.0])   # name → [count, time]
+
+
+def set_config(**kwargs):
+    """Configure (reference ``mx.profiler.set_config``). Accepts the
+    reference's kwargs; ``filename`` names the trace output directory
+    stem."""
+    _config.update(kwargs)
+
+
+def set_state(state: str = "stop", profile_process: str = "worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def _hook(name: str, dt: float):
+    _agg[name][0] += 1
+    _agg[name][1] += dt
+
+
+def _install_hook():
+    from .ndarray import ndarray as nd_mod
+    if getattr(nd_mod, "_profile_hook", None) is None:
+        nd_mod._profile_hook = _hook
+
+
+def _uninstall_hook():
+    from .ndarray import ndarray as nd_mod
+    nd_mod._profile_hook = None
+
+
+def _start(clear_agg: bool):
+    import jax
+    if _state["running"]:
+        return
+    trace_dir = os.path.splitext(_config["filename"])[0] + "_trace"
+    os.makedirs(trace_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(trace_dir)
+        _state["trace_dir"] = trace_dir
+    except Exception:
+        _state["trace_dir"] = None     # e.g. a foreign trace is active
+    if clear_agg:
+        _agg.clear()
+    _install_hook()
+    _state["running"] = True
+
+
+def start():
+    """Start profiling (reference ``mx.profiler.start``)."""
+    _start(clear_agg=True)
+
+
+def resume(profile_process: str = "worker"):
+    """Continue after pause() — aggregate stats keep accumulating."""
+    _start(clear_agg=False)
+
+
+def stop():
+    if not _state["running"]:
+        return
+    import jax
+    if _state["trace_dir"] is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+    _uninstall_hook()
+    _state["running"] = False
+
+
+pause = stop
+
+
+def dump(finished: bool = True, profile_process: str = "worker"):
+    """Finish + write the trace (reference ``mx.profiler.dump``)."""
+    if _state["running"]:
+        stop()
+
+
+def dumps(reset: bool = False, format: str = "table") -> str:
+    """Aggregate per-op dispatch stats (reference aggregate_stats table)."""
+    rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+    lines = [f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"]
+    for name, (count, t) in rows:
+        lines.append(f"{name:<40}{count:>12}{t * 1e3:>14.3f}")
+    if reset:
+        _agg.clear()
+    return "\n".join(lines)
+
+
+class Marker:
+    """Instant event (reference ``mx.profiler.Marker``)."""
+
+    def __init__(self, name: str, domain=None):
+        self.name = name
+
+    def mark(self, scope: str = "process"):
+        _hook(f"marker:{self.name}", 0.0)
+
+
+class Counter:
+    """Named counter (reference ``mx.profiler.Counter``)."""
+
+    def __init__(self, name: str, domain=None, value: Optional[int] = None):
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value: int):
+        self.value = value
+
+    def increment(self, delta: int = 1):
+        self.value += delta
+
+    def decrement(self, delta: int = 1):
+        self.value -= delta
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class Task:
+    """Named duration (reference ``mx.profiler.Task``); also usable as a
+    context manager."""
+
+    def __init__(self, name: str, domain=None):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            _hook(f"task:{self.name}", time.perf_counter() - self._t0)
+            self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+Frame = Task

@@ -1,0 +1,75 @@
+"""mx.util (reference ``python/mxnet/util.py`` [path cite — unverified]):
+np-mode switches/decorators and small helpers."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "use_np", "use_np_array", "use_np_shape", "np_array", "np_shape",
+           "makedirs", "get_gpu_count", "get_gpu_memory"]
+
+
+def set_np(shape=True, array=True, dtype=False):
+    from . import numpy_extension as npx
+    npx.set_np(shape=shape, array=array, dtype=dtype)
+
+
+def reset_np():
+    from . import numpy_extension as npx
+    npx.reset_np()
+
+
+def is_np_array() -> bool:
+    from . import numpy_extension as npx
+    return npx.is_np_array()
+
+
+def is_np_shape() -> bool:
+    return True
+
+
+def use_np_array(func):
+    """Decorator running ``func`` in np-array mode (reference
+    ``mx.util.use_np_array``)."""
+    from . import numpy_extension as npx
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with npx.np_array(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np_shape(func):
+    # np-shape is always on in the rebuild (jax has numpy shape
+    # semantics natively); identity decorator for API parity
+    return func
+
+
+def use_np(func):
+    return use_np_array(use_np_shape(func))
+
+
+def np_shape(active=True):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def np_array(active=True):
+    from . import numpy_extension as npx
+    return npx.np_array(active)
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count() -> int:
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id: int = 0):
+    raise RuntimeError("GPU memory query is not applicable on TPU; use "
+                       "jax.local_devices()[i].memory_stats()")

@@ -1,0 +1,167 @@
+"""Tests for profiler / amp / runtime / util / engine / monitor
+(reference tests/python/unittest/test_profiler.py + test_amp patterns)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import amp, autograd, gluon
+from mxtpu.gluon import nn
+
+
+def test_profiler_aggregate(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "prof.json"),
+                           profile_all=True, aggregate_stats=True)
+    mx.profiler.start()
+    a = mx.nd.ones((4, 4))
+    b = (a * 2 + 1).sum()
+    b.wait_to_read()
+    mx.profiler.stop()
+    table = mx.profiler.dumps()
+    assert "mul" in table and "sum" in table
+    # hooks removed after stop: new ops don't change the aggregate
+    c = (a * 3).sum()
+    assert mx.profiler.dumps() == table
+
+
+def test_profiler_task_counter():
+    c = mx.profiler.Counter("samples")
+    c += 5
+    c -= 2
+    assert c.value == 3
+    with mx.profiler.Task("block"):
+        pass
+    mx.profiler.Marker("evt").mark()
+
+
+def test_amp_convert_hybrid_block():
+    amp.init("bfloat16")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.BatchNorm(in_channels=8),
+                nn.Dense(2, in_units=8))
+    net.initialize()
+    amp.convert_hybrid_block(net)
+    assert onp.dtype(net[0].weight.dtype) == onp.dtype("bfloat16")
+    assert onp.dtype(net[1].gamma.dtype) == onp.float32  # norm stays f32
+    x = mx.nd.ones((2, 4)).astype("bfloat16")
+    y = net(x)
+    assert y.dtype == onp.dtype("bfloat16")
+
+
+def test_amp_loss_scaler_and_trainer():
+    amp.init("float16")
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    x = mx.nd.ones((2, 4))
+    with autograd.record():
+        with amp.scale_loss(net(x).sum(), tr) as scaled:
+            pass
+        scaled.backward()
+    # grads carry the scale; trainer._scale compensates
+    assert tr._scale == pytest.approx(1.0 / tr._amp_loss_scaler.loss_scale)
+    assert amp.unscale(tr)                 # finite, unscaled eagerly
+    g = net.weight.grad()
+    # dL/dW[u,i] = sum over the batch of x[b,i] = 2 (batch of 2 ones)
+    onp.testing.assert_allclose(g.asnumpy(), 2 * onp.ones((2, 4)),
+                                rtol=1e-3)
+
+
+def test_loss_scaler_overflow():
+    from mxtpu.amp.loss_scaler import LossScaler
+    s = LossScaler(init_scale=1024, scale_window=2)
+    assert not s.has_overflow([mx.nd.ones((2,))])
+    assert s.has_overflow([mx.nd.array([onp.inf, 1.0])])
+    assert s.loss_scale == 512
+    assert not s.has_overflow([mx.nd.ones((2,))])
+    assert not s.has_overflow([mx.nd.ones((2,))])
+    assert s.loss_scale == 1024            # doubled after window
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert not feats.is_enabled("CUDA")
+    assert len(mx.runtime.feature_list()) > 5
+    assert "CPU" in repr(feats)
+
+
+def test_util_np_mode():
+    from mxtpu import util
+    assert not util.is_np_array()
+
+    @util.use_np
+    def inner():
+        return util.is_np_array()
+
+    assert inner()
+    assert not util.is_np_array()
+    util.makedirs("/tmp/mxtpu_test_dir")
+    assert os.path.isdir("/tmp/mxtpu_test_dir")
+
+
+def test_engine_bulk():
+    from mxtpu import engine
+    prev = engine.set_bulk_size(30)
+    assert engine.set_bulk_size(prev) == 30
+    with engine.bulk(64):
+        pass
+
+
+def test_monitor():
+    sym = mx.sym
+    data = sym.var("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = out.simple_bind(mx.cpu(), data=(2, 8))
+    ex.arg_dict["fc_weight"][:] = 1.0
+    mon = mx.monitor.Monitor(interval=1, monitor_all=True)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=mx.nd.ones((2, 8)))
+    stats = mon.toc()
+    names = [s[1] for s in stats]
+    assert "fc_output" in names
+    assert "fc_weight" in names
+
+
+def test_profiler_pause_resume_accumulates(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "pr.json"))
+    mx.profiler.start()
+    (mx.nd.ones((2,)) * 2).wait_to_read()
+    mx.profiler.pause()
+    mx.profiler.resume()
+    (mx.nd.ones((2,)) * 2).wait_to_read()
+    mx.profiler.stop()
+    # both muls counted across the pause
+    row = [l for l in mx.profiler.dumps().splitlines() if
+           l.startswith("mul")][0]
+    assert int(row.split()[1]) == 2
+    # double-start is a no-op, not a corruption
+    mx.profiler.start()
+    mx.profiler.start()
+    mx.profiler.stop()
+
+
+def test_amp_unscale_scale_window_boundary():
+    # grads divided by the scale that was APPLIED, even when the
+    # window boundary doubles the scaler during unscale
+    amp.init("float16")
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    tr._amp_loss_scaler._scale_window = 1     # double on every clean step
+    x = mx.nd.ones((1, 2))
+    with autograd.record():
+        with amp.scale_loss(net(x).sum(), tr) as L:
+            pass
+        L.backward()
+    applied = tr._amp_loss_scaler.loss_scale
+    assert amp.unscale(tr)
+    assert tr._amp_loss_scaler.loss_scale == applied * 2   # window fired
+    onp.testing.assert_allclose(net.weight.grad().asnumpy(),
+                                onp.ones((1, 2)), rtol=1e-3)
