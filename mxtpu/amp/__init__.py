@@ -98,6 +98,7 @@ def scale_loss(loss, trainer):
     if scaler is None:
         raise MXNetError("call amp.init_trainer(trainer) first")
     trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    trainer._amp_unscaled = False       # fresh scaled grads incoming
     if isinstance(loss, (list, tuple)):
         yield [l * scaler.loss_scale for l in loss]
     else:
@@ -110,6 +111,8 @@ def unscale(trainer):
     scaler = getattr(trainer, "_amp_loss_scaler", None)
     if scaler is None:
         raise MXNetError("call amp.init_trainer(trainer) first")
+    if getattr(trainer, "_amp_unscaled", False):
+        return trainer._amp_last_finite    # idempotent: already unscaled
     params = [p for p in trainer._params
               if p.grad_req != "null" and p._data is not None]
     grads = [p.grad() for p in params]
@@ -121,6 +124,8 @@ def unscale(trainer):
         for g in grads:
             g._set_data(g._data / applied_scale)
         trainer._scale = trainer._amp_original_scale
+    trainer._amp_unscaled = True
+    trainer._amp_last_finite = finite
     return finite
 
 

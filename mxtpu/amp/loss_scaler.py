@@ -21,13 +21,14 @@ class LossScaler:
 
     def has_overflow(self, grads) -> bool:
         """Check grads for inf/nan and update the scale (reference
-        LossScaler.has_overflow + update_scale)."""
+        LossScaler.has_overflow + update_scale). One fused device
+        reduction + one host sync regardless of parameter count."""
         overflow = False
-        for g in grads:
-            data = g._data if hasattr(g, "_data") else g
-            if not bool(jnp.isfinite(data).all()):
-                overflow = True
-                break
+        if grads:
+            datas = [g._data if hasattr(g, "_data") else g for g in grads]
+            finite = jnp.all(jnp.stack(
+                [jnp.isfinite(d).all() for d in datas]))
+            overflow = not bool(finite)
         if overflow:
             self.loss_scale = max(self._min_scale,
                                   self.loss_scale / self._scale_factor)

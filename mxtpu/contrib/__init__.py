@@ -1,0 +1,24 @@
+"""mx.contrib (reference ``python/mxnet/contrib/``): control flow, amp,
+quantization entry points."""
+from ..ndarray.contrib import foreach, while_loop, cond
+
+__all__ = ["foreach", "while_loop", "cond", "amp"]
+
+
+def __getattr__(name):
+    import importlib
+    if name == "amp":
+        return importlib.import_module("mxtpu.amp")
+    if name == "quantization":
+        try:
+            return importlib.import_module("mxtpu.contrib.quantization")
+        except ModuleNotFoundError:
+            raise AttributeError(
+                "mxtpu.contrib.quantization is not available in this "
+                "build") from None
+    if name == "onnx":
+        raise AttributeError(
+            "ONNX import/export is not available in this build (no onnx "
+            "runtime in the environment); use HybridBlock.export / "
+            "SymbolBlock.imports for model interchange")
+    raise AttributeError(f"module 'mxtpu.contrib' has no attribute {name!r}")

@@ -64,12 +64,33 @@ class Trainer:
         self._kv_initialized = True
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
-        """Rescale grads by 1/batch_size, reduce, and update parameters."""
+        """Rescale grads by 1/batch_size, reduce, and update parameters.
+
+        With AMP attached (amp.init_trainer), overflowed float16 grads
+        SKIP the update and shrink the loss scale — the reference's
+        dynamic-loss-scaling step behavior."""
         if not self._kv_initialized:
             self._init_kvstore()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            if getattr(self, "_amp_unscaled", False):
+                overflow = not getattr(self, "_amp_last_finite", True)
+            else:
+                grads = [p.grad() for p in self._params
+                         if p.grad_req != "null" and p._data is not None]
+                overflow = scaler.has_overflow(grads)
+            if overflow:
+                # drop this update; scale_loss picks up the reduced
+                # scale on the next backward
+                self._scale = self._amp_original_scale
+                self._amp_unscaled = False
+                return
         self._optimizer.rescale_grad = self._scale / batch_size
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
+        if scaler is not None:
+            self._scale = self._amp_original_scale
+            self._amp_unscaled = False
 
     def allreduce_grads(self) -> None:
         if self._kvstore is not None and hasattr(self._kvstore,

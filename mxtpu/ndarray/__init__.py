@@ -7,3 +7,17 @@ from . import random
 
 # `mx.nd.zeros_like(x)` style helpers already come from ops; keep module
 # surface aligned with the reference's generated namespace.
+
+
+def __getattr__(name):
+    # Custom (mx.operator registry) and contrib load lazily to avoid
+    # import cycles
+    if name == "Custom":
+        from ..operator import Custom
+        return Custom
+    if name == "contrib":
+        import importlib
+        m = importlib.import_module("mxtpu.ndarray.contrib")
+        globals()["contrib"] = m
+        return m
+    raise AttributeError(f"module 'mxtpu.ndarray' has no attribute {name!r}")

@@ -83,9 +83,11 @@ def test_loss_scaler_overflow():
 
 
 def test_runtime_features():
+    import jax
     feats = mx.runtime.Features()
     assert feats.is_enabled("CPU")
-    assert not feats.is_enabled("CUDA")
+    has_gpu = any(d.platform in ("gpu", "cuda") for d in jax.devices())
+    assert feats.is_enabled("CUDA") == has_gpu
     assert len(mx.runtime.feature_list()) > 5
     assert "CPU" in repr(feats)
 
@@ -165,3 +167,47 @@ def test_amp_unscale_scale_window_boundary():
     assert tr._amp_loss_scaler.loss_scale == applied * 2   # window fired
     onp.testing.assert_allclose(net.weight.grad().asnumpy(),
                                 onp.ones((1, 2)), rtol=1e-3)
+
+
+def test_amp_overflow_skips_update_in_step():
+    amp.init("float16")
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    w0 = net.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    scale0 = tr._amp_loss_scaler.loss_scale
+    x = mx.nd.array([[1e30, 1e30]])       # overflows when scaled
+    with autograd.record():
+        with amp.scale_loss((net(x) * 1e30).sum(), tr) as L:
+            pass
+        L.backward()
+    tr.step(1)
+    # update skipped, weights unchanged, scale halved
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+    assert tr._amp_loss_scaler.loss_scale == scale0 / 2
+    # clean step still updates
+    xs = mx.nd.ones((1, 2))
+    with autograd.record():
+        with amp.scale_loss(net(xs).sum(), tr) as L:
+            pass
+        L.backward()
+    tr.step(1)
+    assert not onp.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_amp_unscale_idempotent():
+    amp.init("float16")
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    x = mx.nd.ones((1, 2))
+    with autograd.record():
+        with amp.scale_loss(net(x).sum(), tr) as L:
+            pass
+        L.backward()
+    assert amp.unscale(tr)
+    g1 = net.weight.grad().asnumpy().copy()
+    assert amp.unscale(tr)                 # no double division
+    onp.testing.assert_allclose(net.weight.grad().asnumpy(), g1)

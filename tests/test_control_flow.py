@@ -1,0 +1,165 @@
+"""Control flow + CustomOp tests (reference
+tests/python/unittest/test_contrib_control_flow.py + test_operator
+custom-op patterns)."""
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd
+from mxtpu.contrib import cond, foreach, while_loop
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    init = mx.nd.zeros((3,))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = foreach(body, data, init)
+    expected = onp.cumsum(onp.arange(12).reshape(4, 3), axis=0)
+    onp.testing.assert_allclose(outs.asnumpy(), expected, rtol=1e-6)
+    onp.testing.assert_allclose(final.asnumpy(), expected[-1], rtol=1e-6)
+
+
+def test_foreach_multiple_states_and_grad():
+    data = mx.nd.array(onp.ones((5, 2), onp.float32))
+    data.attach_grad()
+    s1 = mx.nd.ones((2,))
+    s2 = mx.nd.zeros((2,))
+
+    def body(x, states):
+        a, b = states
+        return x * a, [a * 1.5, b + x]
+
+    with autograd.record():
+        outs, (fa, fb) = foreach(body, data, [s1, s2])
+        loss = outs.sum()
+    loss.backward()
+    # d(sum of x_t * 1.5^t)/dx_t = 1.5^t
+    expected = onp.repeat((1.5 ** onp.arange(5))[:, None], 2, axis=1)
+    onp.testing.assert_allclose(data.grad.asnumpy(), expected, rtol=1e-5)
+    onp.testing.assert_allclose(fb.asnumpy(), [5, 5], rtol=1e-6)
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i * 10, (i + 1, s + i)
+
+    outs, (fi, fs) = while_loop(cond_fn, func,
+                                (mx.nd.array([0.0]), mx.nd.array([0.0])),
+                                max_iterations=8)
+    assert outs.shape == (8, 1)
+    onp.testing.assert_allclose(outs.asnumpy().ravel(),
+                                [0, 10, 20, 30, 40, 0, 0, 0])
+    assert float(fi.asscalar()) == 5
+    assert float(fs.asscalar()) == 10      # 0+1+2+3+4
+
+
+def test_cond():
+    x = mx.nd.array([2.0])
+    y = mx.nd.array([3.0])
+    out = cond((x < y), lambda a, b: a + b, lambda a, b: a - b,
+               inputs=[x, y])
+    # pred is an NDArray input followed by x, y
+    assert float(out.asscalar()) == 5.0
+    out2 = cond((x > y), lambda a, b: a + b, lambda a, b: a - b,
+                inputs=[x, y])
+    assert float(out2.asscalar()) == -1.0
+
+
+def test_cond_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        out = cond(mx.nd.array([1.0]), lambda a: a * a, lambda a: a * 3,
+                   inputs=[x])
+    out.backward()
+    assert float(x.grad.asscalar()) == 4.0
+
+
+@mx.operator.register("scaled_square")
+class ScaledSquareProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return ScaledSquare(self.scale)
+
+
+class ScaledSquare(mx.operator.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], self.scale * x * x)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = in_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], 2.0 * self.scale * x * g)
+
+
+def test_custom_op_forward_backward():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    y = mx.nd.Custom(x, op_type="scaled_square", scale=3.0)
+    onp.testing.assert_allclose(y.asnumpy(),
+                                3 * x.asnumpy() ** 2, rtol=1e-6)
+    x.attach_grad()
+    with autograd.record():
+        z = mx.nd.Custom(x, op_type="scaled_square", scale=3.0).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_custom_op_unregistered():
+    from mxtpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")
+
+
+def test_foreach_in_hybridized_block():
+    from mxtpu import gluon
+
+    class Cumulator(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, _ = foreach(lambda xi, s: (s + xi, s + xi), x,
+                              mx.nd.zeros((2,)))
+            return outs
+
+    net = Cumulator()
+    x = mx.nd.ones((3, 2))
+    y0 = net(x)
+    net.hybridize()
+    net(x)
+    y1 = net(x)
+    onp.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-6)
+
+
+def test_foreach_no_states():
+    outs, finals = foreach(lambda x, s: (x * 2, s), mx.nd.ones((3, 2)), [])
+    onp.testing.assert_allclose(outs.asnumpy(), 2 * onp.ones((3, 2)))
+    assert finals == []
+
+
+def test_contrib_isnan_matches_nd():
+    x = mx.nd.array([1.0, onp.nan])
+    import mxtpu.ndarray.contrib as c
+    onp.testing.assert_allclose(c.isnan(x).asnumpy(),
+                                mx.nd.isnan(x).asnumpy())
