@@ -72,8 +72,41 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense fallback; true sharded-embedding path in mxtpu.sparse
-        self.pull(key, out, priority)
+        """Pull only the requested rows as row_sparse (reference
+        ``KVStore.row_sparse_pull`` — the large-embedding path: workers
+        fetch just the rows their batch touches)."""
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        keys, outs = self._normalize(key, out)
+        if row_ids is None:
+            # sparse out without row_ids: all rows (dense outs fall back
+            # to a plain pull)
+            rids = [None] * len(keys)
+        else:
+            rids = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            val = self._store[k]
+            if rid is None:
+                ids = jnp.arange(val.shape[0], dtype=jnp.int32)
+            else:
+                ids = rid._data.astype(jnp.int32) \
+                    if isinstance(rid, NDArray) \
+                    else jnp.asarray(rid, jnp.int32)
+                # reference semantics: unique + sorted row ids
+                ids = jnp.unique(ids)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if isinstance(t, RowSparseNDArray):
+                    t.data = NDArray(val._data[ids])
+                    t.indices = NDArray(ids)
+                    t._dense_cache = None
+                elif rid is None:
+                    self.pull(k, t, priority)
+                else:
+                    t._set_data(val._data[ids])
 
     # -- optimizer ----------------------------------------------------------
     def set_updater(self, updater: Callable) -> None:

@@ -15,9 +15,18 @@ def __getattr__(name):
     if name == "Custom":
         from ..operator import Custom
         return Custom
+    if name == "sparse":
+        m = _load_sparse()
+        globals()["sparse"] = m
+        return m
     if name == "contrib":
         import importlib
         m = importlib.import_module("mxtpu.ndarray.contrib")
         globals()["contrib"] = m
         return m
     raise AttributeError(f"module 'mxtpu.ndarray' has no attribute {name!r}")
+
+
+def _load_sparse():
+    import importlib
+    return importlib.import_module("mxtpu.ndarray.sparse")

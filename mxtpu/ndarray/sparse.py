@@ -1,0 +1,321 @@
+"""Sparse NDArrays (reference ``python/mxnet/ndarray/sparse.py`` over
+``src/ndarray`` sparse chunks + ``src/operator/tensor/dot`` sparse
+kernels [path cites — unverified]): ``CSRNDArray`` and
+``RowSparseNDArray``.
+
+TPU-first design: storage is a fixed set of dense jax arrays (static
+shapes — XLA requires them), and the sparse matmuls lower to
+gather + segment-sum, which XLA maps onto the MXU/VPU without
+materializing the dense matrix. ``row_sparse`` keeps its reference role
+as the sharded-embedding/lazy-update gradient format (SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from .ndarray import NDArray, apply_op, array as nd_array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "BaseSparseNDArray", "retain", "dot",
+           "add", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common sparse behavior; ``_data`` holds the DENSE materialization
+    lazily (only when an op needs it), sparse storage lives in the
+    companion arrays."""
+
+    def __init__(self, shape):
+        super().__init__(None)
+        self._dense_cache = None
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense_raw()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, v):
+        # NDArray.__init__ assigns _data=None; sparse subclasses ignore it
+        if v is not None:
+            raise MXNetError("cannot assign dense data to a sparse array")
+
+    def _to_dense_raw(self):
+        raise NotImplementedError
+
+    def tostype(self, stype: str):
+        if stype == "default":
+            return NDArray(self._to_dense_raw())
+        if stype == self.stype:
+            return self
+        if stype == "row_sparse" and self.stype == "csr":
+            return RowSparseNDArray.from_dense(self._to_dense_raw())
+        if stype == "csr" and self.stype == "row_sparse":
+            return CSRNDArray.from_dense(self._to_dense_raw())
+        raise ValueError(f"cannot convert {self.stype} to {stype}")
+
+    def asnumpy(self):
+        return onp.asarray(self._to_dense_raw())
+
+    def astype(self, dtype, copy=True):
+        raise NotImplementedError
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.shape} "
+                f"nnz-storage={self._storage_rows()}>")
+
+    def _storage_rows(self):
+        raise NotImplementedError
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference ``CSRNDArray``)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        super().__init__(shape)
+        self.data = NDArray(jnp.asarray(data)) \
+            if not isinstance(data, NDArray) else data
+        self.indices = NDArray(jnp.asarray(indices, jnp.int32)) \
+            if not isinstance(indices, NDArray) else indices
+        self.indptr = NDArray(jnp.asarray(indptr, jnp.int32)) \
+            if not isinstance(indptr, NDArray) else indptr
+
+    @classmethod
+    def from_dense(cls, dense) -> "CSRNDArray":
+        d = onp.asarray(dense)
+        if d.ndim != 2:
+            raise ValueError("csr requires a 2-D array")
+        import scipy.sparse as sp
+        m = sp.csr_matrix(d)
+        return cls(m.data.astype(d.dtype), m.indices.astype(onp.int32),
+                   m.indptr.astype(onp.int32), d.shape)
+
+    def _to_dense_raw(self):
+        n_rows, n_cols = self.shape
+        data = self.data._data
+        nnz = data.shape[0]
+        row_ids = jnp.searchsorted(self.indptr._data,
+                                   jnp.arange(nnz, dtype=jnp.int32),
+                                   side="right") - 1
+        out = jnp.zeros(self.shape, data.dtype)
+        return out.at[row_ids, self.indices._data].add(data)
+
+    def _storage_rows(self):
+        return int(self.data._data.shape[0])
+
+    @property
+    def dtype(self):
+        return onp.dtype(self.data._data.dtype)
+
+    def asscipy(self):
+        import scipy.sparse as sp
+        return sp.csr_matrix(
+            (onp.asarray(self.data._data), onp.asarray(self.indices._data),
+             onp.asarray(self.indptr._data)), shape=self.shape)
+
+    def astype(self, dtype, copy=True):
+        return CSRNDArray(self.data.astype(dtype), self.indices,
+                          self.indptr, self.shape)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            if key.step is not None:
+                raise ValueError("csr slicing supports contiguous rows")
+            start, stop, _ = key.indices(self.shape[0])
+            indptr = self.indptr._data
+            lo, hi = int(indptr[start]), int(indptr[stop])
+            return CSRNDArray(
+                NDArray(self.data._data[lo:hi]),
+                NDArray(self.indices._data[lo:hi]),
+                NDArray(indptr[start:stop + 1] - indptr[start]),
+                (stop - start, self.shape[1]))
+        if isinstance(key, int):
+            key = key % self.shape[0]          # negative indices
+            return self[key:key + 1]
+        raise TypeError(f"csr indexing with {type(key)} unsupported")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse tensor (reference ``RowSparseNDArray``): a set of
+    present rows (``indices``) + their dense values (``data``)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        super().__init__(shape)
+        self.data = NDArray(jnp.asarray(data)) \
+            if not isinstance(data, NDArray) else data
+        self.indices = NDArray(jnp.asarray(indices, jnp.int32)) \
+            if not isinstance(indices, NDArray) else indices
+
+    @classmethod
+    def from_dense(cls, dense) -> "RowSparseNDArray":
+        d = onp.asarray(dense)
+        present = onp.where(onp.any(d.reshape(d.shape[0], -1) != 0,
+                                    axis=1))[0]
+        return cls(d[present], present.astype(onp.int32), d.shape)
+
+    def _to_dense_raw(self):
+        out = jnp.zeros(self.shape, self.data._data.dtype)
+        return out.at[self.indices._data].set(self.data._data)
+
+    def _storage_rows(self):
+        return int(self.indices._data.shape[0])
+
+    @property
+    def dtype(self):
+        return onp.dtype(self.data._data.dtype)
+
+    def astype(self, dtype, copy=True):
+        return RowSparseNDArray(self.data.astype(dtype), self.indices,
+                                self.shape)
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        return retain(self, row_ids)
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference mx.nd.sparse.csr_matrix / row_sparse_array)
+# ---------------------------------------------------------------------------
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise ValueError("shape is required with (data, indices, "
+                             "indptr)")
+        dt = dtype_np(dtype) if dtype else None
+        d = onp.asarray(data, dt)
+        return CSRNDArray(d, onp.asarray(indices), onp.asarray(indptr),
+                          shape)
+    if isinstance(arg1, NDArray):
+        return CSRNDArray.from_dense(arg1.asnumpy())
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(arg1):
+            m = arg1.tocsr()
+            return CSRNDArray(m.data, m.indices, m.indptr, m.shape)
+    except ImportError:
+        pass
+    return CSRNDArray.from_dense(onp.asarray(
+        arg1, dtype_np(dtype) if dtype else None))
+
+
+def row_sparse_array(arg1, shape=None, ctx=None,
+                     dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise ValueError("shape is required with (data, indices)")
+        return RowSparseNDArray(onp.asarray(
+            data, dtype_np(dtype) if dtype else None),
+            onp.asarray(indices), shape)
+    if isinstance(arg1, NDArray):
+        return RowSparseNDArray.from_dense(arg1.asnumpy())
+    return RowSparseNDArray.from_dense(onp.asarray(
+        arg1, dtype_np(dtype) if dtype else None))
+
+
+def zeros(stype: str, shape, ctx=None, dtype=None):
+    dt = dtype_np(dtype)
+    if stype == "csr":
+        return CSRNDArray(onp.zeros((0,), dt), onp.zeros((0,), onp.int32),
+                          onp.zeros((shape[0] + 1,), onp.int32), shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(onp.zeros((0,) + tuple(shape[1:]), dt),
+                                onp.zeros((0,), onp.int32), shape)
+    from .ndarray import zeros as dzeros
+    return dzeros(shape, ctx, dtype)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a: bool = False,
+        transpose_b: bool = False):
+    """Sparse-aware dot (reference sparse ``dot``):
+    csr × dense and csrᵀ × dense lower to gather + segment-sum."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and \
+            not isinstance(rhs, BaseSparseNDArray):
+        data = lhs.data._data
+        cols = lhs.indices._data
+        indptr = lhs.indptr._data
+        nnz = data.shape[0]
+        n_rows = lhs.shape[0]
+        row_ids = jnp.searchsorted(indptr,
+                                   jnp.arange(nnz, dtype=jnp.int32),
+                                   side="right") - 1
+
+        def _f(dense):
+            d = dense.T if transpose_b else dense
+            if transpose_a:
+                # out[c] += data * d[row]; out shape (n_cols, k)
+                contrib = data[:, None] * d[row_ids]
+                return jax.ops.segment_sum(contrib, cols,
+                                           num_segments=lhs.shape[1])
+            contrib = data[:, None] * d[cols]
+            return jax.ops.segment_sum(contrib, row_ids,
+                                       num_segments=n_rows)
+        return apply_op(_f, [rhs], "sparse_dot")
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        # fall back through dense for the remaining stype combinations
+        l = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
+        r = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
+        from . import ops
+        return ops.dot(l, r, transpose_a=transpose_a,
+                       transpose_b=transpose_b)
+    from . import ops
+    return ops.dot(lhs, rhs, transpose_a=transpose_a,
+                   transpose_b=transpose_b)
+
+
+def add(lhs, rhs):
+    """Sparse add: rs+rs stays row_sparse; anything else densifies
+    (reference storage-type fallback rules)."""
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise ValueError("shape mismatch")
+        idx = jnp.concatenate([lhs.indices._data, rhs.indices._data])
+        dat = jnp.concatenate([lhs.data._data, rhs.data._data])
+        uniq, inv = jnp.unique(idx, return_inverse=True,
+                               size=idx.shape[0], fill_value=-1)
+        summed = jax.ops.segment_sum(dat, inv,
+                                     num_segments=idx.shape[0])
+        keep = uniq >= 0
+        return RowSparseNDArray(
+            NDArray(summed[keep]), NDArray(uniq[keep]), lhs.shape)
+    l = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
+    return l + r
+
+
+def retain(rsp: RowSparseNDArray, row_ids) -> RowSparseNDArray:
+    """Keep only the requested rows (reference ``sparse.retain``) — the
+    row_sparse_pull primitive."""
+    ids = row_ids._data if isinstance(row_ids, NDArray) else \
+        jnp.asarray(row_ids, jnp.int32)
+    ids = ids.astype(jnp.int32)
+    # membership of each stored row in row_ids
+    present = jnp.isin(rsp.indices._data, ids)
+    keep = onp.asarray(present)
+    data = onp.asarray(rsp.data._data)[keep]
+    indices = onp.asarray(rsp.indices._data)[keep]
+    return RowSparseNDArray(data, indices, rsp.shape)

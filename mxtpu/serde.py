@@ -40,6 +40,34 @@ def _np_of(arr) -> _np.ndarray:
     return _np.asarray(arr)
 
 
+def _write_sparse(out: List[bytes], arr) -> None:
+    """Sparse entry: stype (1=row_sparse, 2=csr per the reference
+    storage-type enum), shape, then aux arrays + data as dense blocks."""
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    out.append(struct.pack("<I", _ND_MAGIC))
+    # 1001/1002 (not the reference's 1/2): our sparse block layout is
+    # mxtpu-specific, so genuine MXNet 1.x sparse entries still get the
+    # clean unsupported-format error below instead of a misparse
+    stype = 1001 if isinstance(arr, RowSparseNDArray) else 1002
+    out.append(struct.pack("<i", stype))
+    out.append(struct.pack("<I", len(arr.shape)))
+    out.append(struct.pack(f"<{len(arr.shape)}I", *arr.shape))
+    out.append(struct.pack("<ii", 1, 0))  # cpu ctx
+    if stype == 1001:
+        auxes = [arr.indices.asnumpy().astype("int32")]
+    else:
+        auxes = [arr.indptr.asnumpy().astype("int32"),
+                 arr.indices.asnumpy().astype("int32")]
+    data = arr.data.asnumpy()
+    out.append(struct.pack("<i", _TYPE_FLAG[_np.dtype(data.dtype).name]))
+    out.append(struct.pack("<I", len(auxes)))
+    for aux in auxes:
+        out.append(struct.pack("<I", aux.shape[0]))
+        out.append(aux.tobytes())
+    out.append(struct.pack("<I", data.shape[0]))
+    out.append(_np.ascontiguousarray(data).tobytes())
+
+
 def _write_ndarray(out: List[bytes], a: _np.ndarray) -> None:
     out.append(struct.pack("<I", _ND_MAGIC))
     out.append(struct.pack("<i", 0))  # kDefaultStorage (dense)
@@ -77,8 +105,15 @@ def _read_ndarray(r: _Reader) -> _np.ndarray:
     stype = r.read("i")
     # 0 == kDefaultStorage; accept -1 (kUndefinedStorage) written by early
     # versions of this codec
-    if stype not in (0, -1):
-        raise MXNetError("sparse .params entries not supported yet")
+    if stype in (1, 2):
+        raise MXNetError(
+            "reference MXNet sparse .params entries (row_sparse/csr with "
+            "the 1.x aux layout) are not supported; convert to dense or "
+            "re-save with mxtpu")
+    if stype not in (0, -1, 1001, 1002):
+        raise MXNetError(f"unknown storage type {stype} in .params")
+    if stype in (1001, 1002):
+        return _read_sparse(r, stype)
     ndim = r.read("I")
     shape = tuple(r.read(f"{ndim}I")) if ndim > 1 else \
         ((r.read("I"),) if ndim == 1 else ())
@@ -88,6 +123,33 @@ def _read_ndarray(r: _Reader) -> _np.ndarray:
     n = int(_np.prod(shape)) if shape else 1
     data = _np.frombuffer(r.read_bytes(n * dtype.itemsize), dtype=dtype)
     return data.reshape(shape).copy()
+
+
+def _read_sparse(r: _Reader, stype: int):
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    ndim = r.read("I")
+    shape = tuple(r.read(f"{ndim}I")) if ndim > 1 else \
+        ((r.read("I"),) if ndim == 1 else ())
+    r.read("ii")  # ctx
+    flag = r.read("i")
+    dtype = dtype_np(_FLAG_TYPE[flag])
+    n_aux = r.read("I")
+    auxes = []
+    for _ in range(n_aux):
+        n = r.read("I")
+        auxes.append(_np.frombuffer(r.read_bytes(n * 4),
+                                    dtype=_np.int32).copy())
+    n_data = r.read("I")
+    if stype == 1001:
+        row_shape = shape[1:]
+        count = n_data
+        nbytes = count * int(_np.prod(row_shape or (1,))) * dtype.itemsize
+        data = _np.frombuffer(r.read_bytes(nbytes), dtype=dtype).reshape(
+            (count,) + tuple(row_shape)).copy()
+        return RowSparseNDArray(data, auxes[0], shape)
+    data = _np.frombuffer(r.read_bytes(n_data * dtype.itemsize),
+                          dtype=dtype).copy()
+    return CSRNDArray(data, auxes[1], auxes[0], shape)
 
 
 def save_ndarrays(fname: str, data) -> None:
@@ -104,8 +166,12 @@ def save_ndarrays(fname: str, data) -> None:
         raise TypeError(f"cannot save {type(data)}")
     out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
                         struct.pack("<Q", len(arrays))]
+    from .ndarray.sparse import BaseSparseNDArray
     for a in arrays:
-        _write_ndarray(out, _np_of(a))
+        if isinstance(a, BaseSparseNDArray):
+            _write_sparse(out, a)
+        else:
+            _write_ndarray(out, _np_of(a))
     out.append(struct.pack("<Q", len(names)))
     for nm in names:
         b = nm.encode("utf-8")
@@ -123,7 +189,11 @@ def load_ndarrays(fname: str):
     if magic != _LIST_MAGIC:
         raise MXNetError(f"invalid .params file (magic {magic:#x})")
     n = r.read("Q")
-    arrays = [nd_array(_read_ndarray(r)) for _ in range(n)]
+    from .ndarray.sparse import BaseSparseNDArray
+
+    def _wrap(x):
+        return x if isinstance(x, BaseSparseNDArray) else nd_array(x)
+    arrays = [_wrap(_read_ndarray(r)) for _ in range(n)]
     n_names = r.read("Q")
     if n_names == 0:
         return arrays

@@ -1,0 +1,162 @@
+"""Sparse NDArray tests (reference
+tests/python/unittest/test_sparse_ndarray.py + test_sparse_operator.py
+patterns; scipy is ground truth)."""
+import numpy as onp
+import pytest
+import scipy.sparse as sp
+
+import mxtpu as mx
+from mxtpu.ndarray import sparse
+
+
+def _rand_csr(m, n, density=0.3, seed=0):
+    rng = onp.random.default_rng(seed)
+    mat = sp.random(m, n, density=density, random_state=seed,
+                    dtype=onp.float32, format="csr")
+    return mat
+
+
+def test_csr_round_trip():
+    mat = _rand_csr(6, 8)
+    a = sparse.csr_matrix((mat.data, mat.indices, mat.indptr),
+                          shape=mat.shape)
+    onp.testing.assert_allclose(a.asnumpy(), mat.toarray(), rtol=1e-6)
+    assert a.stype == "csr"
+    back = a.asscipy()
+    assert (back != mat).nnz == 0
+    # from dense
+    b = sparse.csr_matrix(mat.toarray())
+    onp.testing.assert_allclose(b.asnumpy(), mat.toarray(), rtol=1e-6)
+
+
+def test_csr_tostype_and_slice():
+    mat = _rand_csr(6, 4)
+    a = sparse.csr_matrix(mat)
+    dense = a.tostype("default")
+    assert dense.stype == "default"
+    onp.testing.assert_allclose(dense.asnumpy(), mat.toarray(), rtol=1e-6)
+    s = a[1:4]
+    onp.testing.assert_allclose(s.asnumpy(), mat.toarray()[1:4], rtol=1e-6)
+
+
+def test_csr_dot_dense():
+    mat = _rand_csr(5, 7)
+    rhs = onp.random.default_rng(1).standard_normal((7, 3)).astype(
+        onp.float32)
+    a = sparse.csr_matrix(mat)
+    out = sparse.dot(a, mx.nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), mat.toarray() @ rhs,
+                                rtol=1e-5, atol=1e-6)
+    # transpose_a: (n, m) @ (m, k)
+    rhs2 = onp.random.default_rng(2).standard_normal((5, 2)).astype(
+        onp.float32)
+    out2 = sparse.dot(a, mx.nd.array(rhs2), transpose_a=True)
+    onp.testing.assert_allclose(out2.asnumpy(), mat.toarray().T @ rhs2,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_row_sparse_basics():
+    dense = onp.zeros((6, 3), onp.float32)
+    dense[1] = [1, 2, 3]
+    dense[4] = [4, 5, 6]
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert rs.indices.asnumpy().tolist() == [1, 4]
+    onp.testing.assert_allclose(rs.asnumpy(), dense)
+    # explicit construction
+    rs2 = sparse.row_sparse_array(
+        ([[1.0, 1, 1]], [2]), shape=(5, 3))
+    assert rs2.asnumpy()[2].tolist() == [1, 1, 1]
+    assert rs2.asnumpy().sum() == 3
+
+
+def test_row_sparse_add_and_retain():
+    a = sparse.row_sparse_array(([[1.0, 1]], [0]), shape=(4, 2))
+    b = sparse.row_sparse_array(([[2.0, 2], [3, 3]], [0, 2]), shape=(4, 2))
+    c = sparse.add(a, b)
+    assert c.stype == "row_sparse"
+    expected = onp.zeros((4, 2))
+    expected[0] = 3
+    expected[2] = 3
+    onp.testing.assert_allclose(c.asnumpy(), expected)
+    r = sparse.retain(b, [2])
+    assert r.indices.asnumpy().tolist() == [2]
+    onp.testing.assert_allclose(r.asnumpy()[2], [3, 3])
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (3, 4))
+    assert z.asnumpy().sum() == 0
+    z2 = sparse.zeros("row_sparse", (3, 4))
+    assert z2.asnumpy().shape == (3, 4)
+
+
+def test_sparse_save_load(tmp_path):
+    mat = _rand_csr(5, 6)
+    a = sparse.csr_matrix(mat)
+    rs = sparse.row_sparse_array(([[1.0, 2]], [1]), shape=(4, 2))
+    dense = mx.nd.ones((2, 2))
+    f = str(tmp_path / "mix.params")
+    mx.nd.save(f, {"csr": a, "rs": rs, "dense": dense})
+    loaded = mx.nd.load(f)
+    assert loaded["csr"].stype == "csr"
+    onp.testing.assert_allclose(loaded["csr"].asnumpy(), mat.toarray(),
+                                rtol=1e-6)
+    assert loaded["rs"].stype == "row_sparse"
+    onp.testing.assert_allclose(loaded["rs"].asnumpy(), rs.asnumpy())
+    onp.testing.assert_allclose(loaded["dense"].asnumpy(), onp.ones((2, 2)))
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = onp.random.default_rng(3).standard_normal((10, 4)).astype(
+        onp.float32)
+    kv.init("emb", mx.nd.array(w))
+    out = sparse.zeros("row_sparse", (10, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([2.0, 7.0]))
+    assert out.indices.asnumpy().tolist() == [2, 7]
+    onp.testing.assert_allclose(out.data.asnumpy(), w[[2, 7]], rtol=1e-6)
+    dense = out.asnumpy()
+    assert dense[0].sum() == 0
+    onp.testing.assert_allclose(dense[7], w[7], rtol=1e-6)
+
+
+def test_csr_dense_fallback_ops():
+    mat = _rand_csr(4, 4)
+    a = sparse.csr_matrix(mat)
+    d = mx.nd.ones((4, 4))
+    out = sparse.add(a, d)
+    onp.testing.assert_allclose(out.asnumpy(), mat.toarray() + 1,
+                                rtol=1e-6)
+
+
+def test_csr_dot_transpose_b():
+    mat = _rand_csr(4, 3)
+    rhs = onp.random.default_rng(5).standard_normal((2, 3)).astype(
+        onp.float32)
+    out = sparse.dot(sparse.csr_matrix(mat), mx.nd.array(rhs),
+                     transpose_b=True)
+    onp.testing.assert_allclose(out.asnumpy(), mat.toarray() @ rhs.T,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_csr_negative_index():
+    mat = _rand_csr(4, 3)
+    a = sparse.csr_matrix(mat)
+    onp.testing.assert_allclose(a[-1].asnumpy(),
+                                mat.toarray()[-1:], rtol=1e-6)
+
+
+def test_row_sparse_pull_dedup_and_no_ids():
+    kv = mx.kv.create("local")
+    w = onp.arange(8, dtype=onp.float32).reshape(4, 2)
+    kv.init("w", mx.nd.array(w))
+    out = sparse.zeros("row_sparse", (4, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([1.0, 1.0, 3.0]))
+    assert out.indices.asnumpy().tolist() == [1, 3]     # unique + sorted
+    z = sparse.add(out, sparse.zeros("row_sparse", (4, 2)))
+    onp.testing.assert_allclose(z.asnumpy()[1], w[1])   # no double count
+    # sparse out without row_ids = all rows
+    out2 = sparse.zeros("row_sparse", (4, 2))
+    kv.row_sparse_pull("w", out=out2)
+    onp.testing.assert_allclose(out2.asnumpy(), w)
