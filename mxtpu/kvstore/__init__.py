@@ -82,9 +82,14 @@ class KVStore:
             # sparse out without row_ids: all rows (dense outs fall back
             # to a plain pull)
             rids = [None] * len(keys)
+        elif isinstance(row_ids, (list, tuple)) and row_ids and \
+                not isinstance(row_ids[0], (list, tuple, NDArray)):
+            # a flat python list of ids is ONE id set, not per-key lists
+            rids = [row_ids] * len(keys)
+        elif isinstance(row_ids, (list, tuple)):
+            rids = list(row_ids)
         else:
-            rids = row_ids if isinstance(row_ids, (list, tuple)) \
-                else [row_ids] * len(keys)
+            rids = [row_ids] * len(keys)
         for k, o, rid in zip(keys, outs, rids):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
@@ -106,7 +111,10 @@ class KVStore:
                 elif rid is None:
                     self.pull(k, t, priority)
                 else:
-                    t._set_data(val._data[ids])
+                    raise MXNetError(
+                        "row_sparse_pull with row_ids requires a "
+                        "RowSparseNDArray out (a dense out would be "
+                        "silently reshaped)")
 
     # -- optimizer ----------------------------------------------------------
     def set_updater(self, updater: Callable) -> None:

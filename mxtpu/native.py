@@ -1,0 +1,191 @@
+"""ctypes bindings for libmxtpu (see ``src/libmxtpu.cc``) — the native
+runtime components (RecordIO reader, JPEG decode, threaded decode
+pipeline; the rebuild of the reference's C++ ``src/io`` stack).
+
+The library builds lazily with g++ on first use (no pybind11 in the
+environment — plain C ABI + ctypes per SURVEY.md environment notes);
+everything degrades gracefully to the Python implementations when the
+toolchain or libjpeg is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as onp
+
+_LIB = None
+_LOCK = threading.Lock()
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+
+
+def _build() -> Optional[str]:
+    so = os.path.join(_SRC_DIR, "libmxtpu.so")
+    src = os.path.join(_SRC_DIR, "libmxtpu.cc")
+    if os.path.exists(so):
+        try:
+            if os.path.getmtime(so) >= os.path.getmtime(src):
+                return so
+        except OSError:
+            return so          # prebuilt .so shipped without source
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-shared",
+             src, "-o", so, "-ljpeg", "-lpthread"],
+            check=True, capture_output=True, timeout=120)
+        return so
+    except Exception:
+        return None
+
+
+def get_lib():
+    """Load (building if needed) libmxtpu; None if unavailable."""
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB if _LIB is not False else None
+        so = _build()
+        if so is None:
+            _LIB = False
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _LIB = False
+            return None
+        lib.mxtpu_rec_open.restype = ctypes.c_void_p
+        lib.mxtpu_rec_open.argtypes = [ctypes.c_char_p]
+        lib.mxtpu_rec_count.restype = ctypes.c_long
+        lib.mxtpu_rec_count.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_rec_read.restype = ctypes.c_long
+        lib.mxtpu_rec_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))]
+        lib.mxtpu_rec_close.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_jpeg_decode.restype = ctypes.c_long
+        lib.mxtpu_jpeg_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_ulong, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.mxtpu_pipe_create.restype = ctypes.c_void_p
+        lib.mxtpu_pipe_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint, ctypes.c_int]
+        lib.mxtpu_pipe_next.restype = ctypes.c_long
+        lib.mxtpu_pipe_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        lib.mxtpu_pipe_reset.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class NativeRecordReader:
+    """Random-access RecordIO reader over the native offset index."""
+
+    def __init__(self, path: str):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("libmxtpu unavailable")
+        self._lib = lib
+        self._h = lib.mxtpu_rec_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def __len__(self) -> int:
+        return int(self._lib.mxtpu_rec_count(self._h))
+
+    def read(self, i: int) -> bytes:
+        ptr = ctypes.POINTER(ctypes.c_ubyte)()
+        n = self._lib.mxtpu_rec_read(self._h, i, ctypes.byref(ptr))
+        if n < 0:
+            raise IndexError(i)
+        return bytes(ctypes.cast(
+            ptr, ctypes.POINTER(ctypes.c_ubyte * n)).contents)
+
+    def close(self):
+        if self._h:
+            self._lib.mxtpu_rec_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def jpeg_decode(buf: bytes, channels: int = 3) -> onp.ndarray:
+    """Native JPEG decode → HWC uint8."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("libmxtpu unavailable")
+    arr = (ctypes.c_ubyte * len(buf)).from_buffer_copy(buf)
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    n = lib.mxtpu_jpeg_decode(arr, len(buf), channels, None,
+                              ctypes.byref(w), ctypes.byref(h),
+                              ctypes.byref(c))
+    if n < 0:
+        raise ValueError("JPEG decode failed")
+    out = onp.empty(n, onp.uint8)
+    lib.mxtpu_jpeg_decode(
+        arr, len(buf), channels,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.byref(w), ctypes.byref(h), ctypes.byref(c))
+    return out.reshape(h.value, w.value, c.value)
+
+
+class NativePipeline:
+    """Threaded read+decode+resize pipeline (the reference's C++
+    ImageRecordIOParser2 + prefetcher, rebuilt)."""
+
+    def __init__(self, rec_path: str, height: int, width: int,
+                 channels: int = 3, shuffle: bool = False, seed: int = 0,
+                 threads: int = 2):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("libmxtpu unavailable")
+        self._lib = lib
+        self._hwc = (height, width, channels)
+        self._h = lib.mxtpu_pipe_create(rec_path.encode(), height, width,
+                                        channels, int(shuffle), seed,
+                                        threads)
+        if not self._h:
+            raise IOError(f"cannot open {rec_path}")
+
+    def next_batch(self, batch_size: int):
+        """Returns (data (n,h,w,c) float32, labels (n,)) with n ≤
+        batch_size; n==0 means the epoch is exhausted."""
+        h, w, c = self._hwc
+        data = onp.empty((batch_size, h, w, c), onp.float32)
+        labels = onp.empty((batch_size,), onp.float32)
+        n = self._lib.mxtpu_pipe_next(
+            self._h, batch_size,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return data[:n], labels[:n]
+
+    def reset(self):
+        self._lib.mxtpu_pipe_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.mxtpu_pipe_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

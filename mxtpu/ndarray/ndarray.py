@@ -68,9 +68,12 @@ def apply_op(raw_fn: Callable, arrays: Sequence["NDArray"], name: str = "",
     out, node = autograd.invoke(raw_fn, datas, parents, name)
     if t0 is not None:
         _profile_hook(name, _perf_counter() - t0)
-    # results take the class of the first array input, so mx.np arrays
-    # (NDArray subclass with numpy semantics) propagate through every op
-    cls = next((type(a) for a in arrays if isinstance(a, NDArray)), NDArray)
+    # results take the class of the first DENSE array input, so mx.np
+    # arrays propagate through every op; sparse inputs densify (their
+    # constructors need companion arrays, and op results are dense)
+    cls = next((type(a) for a in arrays
+                if isinstance(a, NDArray) and a.stype == "default"),
+               NDArray)
     if n_out == 1:
         res = cls(out)
         if node is not None:

@@ -31,10 +31,25 @@ class BaseSparseNDArray(NDArray):
     lazily (only when an op needs it), sparse storage lives in the
     companion arrays."""
 
+    # component-array attribute names whose rebinding invalidates the
+    # dense cache
+    _COMPONENTS = ("data", "indices", "indptr")
+
     def __init__(self, shape):
         super().__init__(None)
         self._dense_cache = None
+        self._cache_versions = None
         self._shape = tuple(int(s) for s in shape)
+
+    def __setattr__(self, name, value):
+        if name in BaseSparseNDArray._COMPONENTS and \
+                getattr(self, "_dense_cache", None) is not None:
+            object.__setattr__(self, "_dense_cache", None)
+        object.__setattr__(self, name, value)
+
+    def _component_versions(self):
+        return tuple(getattr(self, n)._version
+                     for n in self._COMPONENTS if hasattr(self, n))
 
     @property
     def shape(self):
@@ -42,8 +57,12 @@ class BaseSparseNDArray(NDArray):
 
     @property
     def _data(self):
-        if self._dense_cache is None:
-            self._dense_cache = self._to_dense_raw()
+        # rebuild when a component NDArray was mutated in place
+        # (their _version counters advance on every write)
+        vers = self._component_versions()
+        if self._dense_cache is None or vers != self._cache_versions:
+            object.__setattr__(self, "_dense_cache", self._to_dense_raw())
+            object.__setattr__(self, "_cache_versions", vers)
         return self._dense_cache
 
     @_data.setter
@@ -147,7 +166,11 @@ class CSRNDArray(BaseSparseNDArray):
                 NDArray(indptr[start:stop + 1] - indptr[start]),
                 (stop - start, self.shape[1]))
         if isinstance(key, int):
-            key = key % self.shape[0]          # negative indices
+            n = self.shape[0]
+            if not -n <= key < n:
+                raise IndexError(
+                    f"index {key} out of range for {n} rows")
+            key = key % n                      # negative indices
             return self[key:key + 1]
         raise TypeError(f"csr indexing with {type(key)} unsupported")
 
@@ -263,15 +286,19 @@ def dot(lhs, rhs, transpose_a: bool = False,
                                    side="right") - 1
 
         def _f(dense):
-            d = dense.T if transpose_b else dense
+            vec = dense.ndim == 1
+            d = dense[:, None] if vec else \
+                (dense.T if transpose_b else dense)
             if transpose_a:
                 # out[c] += data * d[row]; out shape (n_cols, k)
                 contrib = data[:, None] * d[row_ids]
-                return jax.ops.segment_sum(contrib, cols,
-                                           num_segments=lhs.shape[1])
+                out = jax.ops.segment_sum(contrib, cols,
+                                          num_segments=lhs.shape[1])
+                return out[:, 0] if vec else out
             contrib = data[:, None] * d[cols]
-            return jax.ops.segment_sum(contrib, row_ids,
-                                       num_segments=n_rows)
+            out = jax.ops.segment_sum(contrib, row_ids,
+                                      num_segments=n_rows)
+            return out[:, 0] if vec else out
         return apply_op(_f, [rhs], "sparse_dot")
     if isinstance(lhs, BaseSparseNDArray) or \
             isinstance(rhs, BaseSparseNDArray):

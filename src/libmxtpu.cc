@@ -1,0 +1,362 @@
+// libmxtpu — native runtime components (TPU rebuild of the reference's
+// C++ IO stack: src/io/iter_image_recordio_2.cc, dmlc RecordIO reader,
+// image decode/augment [path cites — unverified]).
+//
+// Exposed as a plain C ABI consumed via ctypes (the environment has no
+// pybind11; see mxtpu/native.py). Components:
+//   * RecordIO: offset indexer + pread-based random reader with
+//     multi-part (cflag) reassembly — byte-compatible with the python
+//     codec in mxtpu/recordio.py and dmlc .rec files.
+//   * JPEG decode (libjpeg) + bilinear resize to float32.
+//   * A threaded sample pipeline: worker threads read+decode+resize
+//     records into a bounded queue; the host thread drains batches.
+//     This is the native analogue of ImageRecordIOParser2 + the
+//     PrefetcherIter double buffer.
+//
+// Build: g++ -O3 -shared -fPIC libmxtpu.cc -o libmxtpu.so -ljpeg -lpthread
+
+#include <atomic>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <jpeglib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct RecFile {
+  int fd = -1;
+  std::vector<uint64_t> offsets;   // record starts (first chunk)
+  std::vector<uint8_t> scratch;    // last read payload
+};
+
+// read one chunk at *pos (advancing it); returns false at EOF/corruption
+bool ReadChunk(int fd, uint64_t* pos, std::vector<uint8_t>* out,
+               uint32_t* cflag) {
+  uint32_t header[2];
+  if (pread(fd, header, 8, (off_t)*pos) != 8) return false;
+  if (header[0] != kMagic) return false;
+  *cflag = header[1] >> 29;
+  uint32_t len = header[1] & ((1u << 29) - 1);
+  size_t old = out->size();
+  out->resize(old + len);
+  if (len && pread(fd, out->data() + old, len, (off_t)(*pos + 8)) !=
+                 (ssize_t)len)
+    return false;
+  *pos += 8 + ((len + 3u) & ~3u);
+  return true;
+}
+
+// read a full logical record (reassembling multi-part) at *pos
+bool ReadRecord(int fd, uint64_t* pos, std::vector<uint8_t>* out) {
+  out->clear();
+  uint32_t cflag = 0;
+  std::vector<uint8_t> chunk;
+  if (!ReadChunk(fd, pos, &chunk, &cflag)) return false;
+  if (cflag == 0) {
+    out->swap(chunk);
+    return true;
+  }
+  if (cflag != 1) return false;
+  const uint8_t magic_bytes[4] = {0x0a, 0x23, 0xd7, 0xce};
+  *out = chunk;
+  while (true) {
+    chunk.clear();
+    uint32_t cf = 0;
+    if (!ReadChunk(fd, pos, &chunk, &cf)) return false;
+    out->insert(out->end(), magic_bytes, magic_bytes + 4);
+    out->insert(out->end(), chunk.begin(), chunk.end());
+    if (cf == 3) return true;
+    if (cf != 2) return false;
+  }
+}
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jmp;
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jmp, 1);
+}
+
+// decode JPEG to tightly-packed uint8; returns 0 on success
+int DecodeJpeg(const uint8_t* buf, size_t len, int want_c,
+               std::vector<uint8_t>* out, int* w, int* h, int* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrExit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = want_c == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  *c = cinfo.output_components;
+  out->resize((size_t)(*w) * (*h) * (*c));
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+                   (size_t)cinfo.output_scanline * (*w) * (*c);
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, float* dst,
+                    int dh, int dw) {
+  const float ry = dh > 1 ? (float)(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? (float)(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = (int)fy;
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = (int)fx;
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int k = 0; k < c; ++k) {
+        float v00 = src[((size_t)y0 * sw + x0) * c + k];
+        float v01 = src[((size_t)y0 * sw + x1) * c + k];
+        float v10 = src[((size_t)y1 * sw + x0) * c + k];
+        float v11 = src[((size_t)y1 * sw + x1) * c + k];
+        dst[((size_t)y * dw + x) * c + k] =
+            v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+            v10 * wy * (1 - wx) + v11 * wy * wx;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// threaded decode pipeline
+// ---------------------------------------------------------------------------
+struct Sample {
+  std::vector<float> data;   // h*w*c
+  float label = 0.f;
+};
+
+struct Pipeline {
+  RecFile rec;
+  int h, w, c;
+  bool shuffle;
+  uint32_t seed, epoch = 0;
+  std::vector<uint32_t> order;
+  std::atomic<size_t> next_idx{0};
+  // bounded queue
+  std::deque<Sample> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  size_t max_queue = 64;
+  int nthreads = 1;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  std::atomic<int> active_workers{0};
+
+  void WorkerLoop() {
+    std::vector<uint8_t> record, pixels;
+    while (!stop.load()) {
+      size_t i = next_idx.fetch_add(1);
+      if (i >= order.size()) break;
+      uint64_t pos = rec.offsets[order[i]];
+      if (!ReadRecord(rec.fd, &pos, &record)) break;
+      // IRHeader: uint32 flag, float label, uint64 id[2]
+      if (record.size() < 24) continue;
+      uint32_t flag;
+      float label;
+      memcpy(&flag, record.data(), 4);
+      memcpy(&label, record.data() + 4, 4);
+      size_t off = 24 + (size_t)flag * 4;   // ext labels skipped
+      if (off >= record.size()) continue;   // bounds BEFORE ext read
+      if (flag > 0) memcpy(&label, record.data() + 24, 4);
+      int dw, dh, dc;
+      if (DecodeJpeg(record.data() + off, record.size() - off, c,
+                     &pixels, &dw, &dh, &dc))
+        continue;                            // undecodable: skip
+      Sample s;
+      s.label = label;
+      s.data.resize((size_t)h * w * c);
+      ResizeBilinear(pixels.data(), dh, dw, dc, s.data.data(), h, w);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [&] {
+        return queue.size() < max_queue || stop.load();
+      });
+      if (stop.load()) break;
+      queue.push_back(std::move(s));
+      cv_pop.notify_one();
+    }
+    if (active_workers.fetch_sub(1) == 1) cv_pop.notify_all();
+  }
+
+  void Start(int nthreads) {
+    order.resize(rec.offsets.size());
+    for (size_t i = 0; i < order.size(); ++i) order[i] = (uint32_t)i;
+    if (shuffle) {
+      std::mt19937 rng(seed + epoch);
+      for (size_t i = order.size(); i > 1; --i) {
+        size_t j = rng() % i;
+        std::swap(order[i - 1], order[j]);
+      }
+    }
+    next_idx = 0;
+    stop = false;
+    active_workers = nthreads;
+    for (int t = 0; t < nthreads; ++t)
+      workers.emplace_back([this] { WorkerLoop(); });
+  }
+
+  void Stop() {
+    stop = true;
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    for (auto& t : workers) t.join();
+    workers.clear();
+    std::lock_guard<std::mutex> lk(mu);
+    queue.clear();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// -- recordio ---------------------------------------------------------------
+void* mxtpu_rec_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  RecFile* rf = new RecFile();
+  rf->fd = fd;
+  // index all record starts in one sequential scan
+  uint64_t pos = 0;
+  off_t end = lseek(fd, 0, SEEK_END);
+  while ((off_t)pos + 8 <= end) {
+    uint32_t header[2];
+    if (pread(fd, header, 8, (off_t)pos) != 8 || header[0] != kMagic) break;
+    uint32_t cflag = header[1] >> 29;
+    if (cflag == 0 || cflag == 1) rf->offsets.push_back(pos);
+    pos += 8 + ((header[1] & ((1u << 29) - 1)) + 3u & ~3u);
+  }
+  return rf;
+}
+
+long mxtpu_rec_count(void* h) {
+  return h ? (long)static_cast<RecFile*>(h)->offsets.size() : -1;
+}
+
+// read record i; returns length and sets *data (valid until next call)
+long mxtpu_rec_read(void* h, long i, const uint8_t** data) {
+  RecFile* rf = static_cast<RecFile*>(h);
+  if (!rf || i < 0 || (size_t)i >= rf->offsets.size()) return -1;
+  uint64_t pos = rf->offsets[i];
+  if (!ReadRecord(rf->fd, &pos, &rf->scratch)) return -1;
+  *data = rf->scratch.data();
+  return (long)rf->scratch.size();
+}
+
+void mxtpu_rec_close(void* h) {
+  RecFile* rf = static_cast<RecFile*>(h);
+  if (rf) {
+    if (rf->fd >= 0) close(rf->fd);
+    delete rf;
+  }
+}
+
+// -- jpeg -------------------------------------------------------------------
+// decode into caller buffer after a probe call with out=null; returns
+// needed byte count or -1
+long mxtpu_jpeg_decode(const uint8_t* buf, unsigned long len, int want_c,
+                       uint8_t* out, int* w, int* h, int* c) {
+  std::vector<uint8_t> pixels;
+  if (DecodeJpeg(buf, len, want_c, &pixels, w, h, c)) return -1;
+  if (out) memcpy(out, pixels.data(), pixels.size());
+  return (long)pixels.size();
+}
+
+void mxtpu_resize_bilinear(const uint8_t* src, int sh, int sw, int c,
+                           float* dst, int dh, int dw) {
+  ResizeBilinear(src, sh, sw, c, dst, dh, dw);
+}
+
+// -- pipeline ---------------------------------------------------------------
+void* mxtpu_pipe_create(const char* rec_path, int h, int w, int c,
+                        int shuffle, unsigned seed, int nthreads) {
+  void* rh = mxtpu_rec_open(rec_path);
+  if (!rh) return nullptr;
+  Pipeline* p = new Pipeline();
+  p->rec = *static_cast<RecFile*>(rh);
+  static_cast<RecFile*>(rh)->fd = -1;      // ownership moved
+  mxtpu_rec_close(rh);
+  p->h = h;
+  p->w = w;
+  p->c = c;
+  p->shuffle = shuffle != 0;
+  p->seed = seed;
+  p->nthreads = nthreads > 0 ? nthreads : 1;
+  p->Start(p->nthreads);
+  return p;
+}
+
+// fill up to batch samples; returns count (0 = epoch exhausted)
+long mxtpu_pipe_next(void* h, long batch, float* data, float* labels) {
+  Pipeline* p = static_cast<Pipeline*>(h);
+  long filled = 0;
+  size_t sample_sz = (size_t)p->h * p->w * p->c;
+  while (filled < batch) {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_pop.wait(lk, [&] {
+      return !p->queue.empty() || p->active_workers.load() == 0;
+    });
+    if (p->queue.empty()) break;             // workers done + drained
+    Sample s = std::move(p->queue.front());
+    p->queue.pop_front();
+    lk.unlock();
+    p->cv_push.notify_one();
+    memcpy(data + filled * sample_sz, s.data.data(),
+           sample_sz * sizeof(float));
+    labels[filled] = s.label;
+    ++filled;
+  }
+  return filled;
+}
+
+void mxtpu_pipe_reset(void* h) {
+  Pipeline* p = static_cast<Pipeline*>(h);
+  p->Stop();
+  p->epoch += 1;
+  p->Start(p->nthreads);
+}
+
+void mxtpu_pipe_destroy(void* h) {
+  Pipeline* p = static_cast<Pipeline*>(h);
+  if (p) {
+    p->Stop();
+    if (p->rec.fd >= 0) close(p->rec.fd);
+    delete p;
+  }
+}
+
+}  // extern "C"

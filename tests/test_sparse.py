@@ -160,3 +160,54 @@ def test_row_sparse_pull_dedup_and_no_ids():
     out2 = sparse.zeros("row_sparse", (4, 2))
     kv.row_sparse_pull("w", out=out2)
     onp.testing.assert_allclose(out2.asnumpy(), w)
+
+
+def test_sparse_inherited_ops_densify():
+    mat = _rand_csr(3, 4)
+    a = sparse.csr_matrix(mat)
+    out = a + mx.nd.ones((3, 4))
+    assert type(out) is mx.nd.NDArray and out.stype == "default"
+    onp.testing.assert_allclose(out.asnumpy(), mat.toarray() + 1,
+                                rtol=1e-6)
+    s = a.sum()
+    onp.testing.assert_allclose(float(s.asscalar()), mat.toarray().sum(),
+                                rtol=1e-5)
+
+
+def test_sparse_dense_cache_invalidation():
+    rs = sparse.row_sparse_array(([[1.0, 1]], [0]), shape=(3, 2))
+    first = (rs + mx.nd.zeros((3, 2))).asnumpy()
+    rs.data[:] = 5.0                     # in-place component mutation
+    second = (rs + mx.nd.zeros((3, 2))).asnumpy()
+    assert second[0].tolist() == [5, 5]
+    assert first[0].tolist() == [1, 1]
+
+
+def test_sparse_dot_vector():
+    mat = _rand_csr(5, 7)
+    v = onp.random.default_rng(9).standard_normal(7).astype(onp.float32)
+    out = sparse.dot(sparse.csr_matrix(mat), mx.nd.array(v))
+    assert out.shape == (5,)
+    onp.testing.assert_allclose(out.asnumpy(), mat.toarray() @ v,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_csr_index_out_of_range():
+    a = sparse.csr_matrix(_rand_csr(4, 3))
+    with pytest.raises(IndexError):
+        a[10]
+    with pytest.raises(IndexError):
+        a[-9]
+
+
+def test_row_sparse_pull_list_ids_and_dense_guard():
+    from mxtpu.base import MXNetError
+    kv = mx.kv.create("local")
+    w = onp.arange(8, dtype=onp.float32).reshape(4, 2)
+    kv.init("w2", mx.nd.array(w))
+    out = sparse.zeros("row_sparse", (4, 2))
+    kv.row_sparse_pull("w2", out=out, row_ids=[1, 3])   # flat python list
+    assert out.indices.asnumpy().tolist() == [1, 3]
+    dense_out = mx.nd.zeros((4, 2))
+    with pytest.raises(MXNetError):
+        kv.row_sparse_pull("w2", out=dense_out, row_ids=[1])
