@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Per-operator performance harness (reference ``benchmark/opperf/``
+[path cite — unverified]): times forward (and backward where
+differentiable) for registered ops on synthetic inputs, printing a
+table + JSON.
+
+Usage:
+    python benchmark/opperf/opperf.py            # default op set
+    python benchmark/opperf/opperf.py --ops dot,Convolution --json out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+
+def _inputs(mx, name):
+    """Synthetic inputs per op category (reference DEFAULT_* shapes)."""
+    rng = onp.random.default_rng(0)
+    big = mx.nd.array(rng.standard_normal((1024, 1024)).astype("float32"))
+    vec = mx.nd.array(rng.standard_normal((1024 * 1024,)).astype("float32"))
+    img = mx.nd.array(rng.standard_normal((32, 3, 64, 64)).astype("float32"))
+    w = mx.nd.array(rng.standard_normal((16, 3, 3, 3)).astype("float32"))
+    fcw = mx.nd.array(rng.standard_normal((256, 1024)).astype("float32"))
+    specs = {
+        "dot": ((big, big), {}),
+        "batch_dot": ((mx.nd.array(rng.standard_normal((32, 128, 128))),
+                       mx.nd.array(rng.standard_normal((32, 128, 128)))), {}),
+        "FullyConnected": ((big, fcw), {"num_hidden": 256}),
+        "Convolution": ((img, w), {"kernel": (3, 3), "num_filter": 16,
+                                   "pad": (1, 1)}),
+        "Pooling": ((img,), {"kernel": (2, 2), "stride": (2, 2),
+                             "pool_type": "max"}),
+        "softmax": ((big,), {}),
+        "BatchNorm": ((img, mx.nd.ones((3,)), mx.nd.zeros((3,)),
+                       mx.nd.zeros((3,)), mx.nd.ones((3,))), {}),
+        "LayerNorm": ((big, mx.nd.ones((1024,)), mx.nd.zeros((1024,))), {}),
+        "sum": ((big,), {}),
+        "transpose": ((big,), {}),
+        "broadcast_add": ((big, big), {}),
+        "relu": ((vec,), {}),
+        "sigmoid": ((vec,), {}),
+        "exp": ((vec,), {}),
+        "topk": ((big,), {"k": 10}),
+        "sort": ((vec,), {}),
+        "take": ((big, mx.nd.array(rng.integers(0, 1024, 4096)
+                                   .astype("float32"))), {}),
+        "one_hot": ((mx.nd.array(rng.integers(0, 128, 8192)
+                                 .astype("float32")),), {"depth": 128}),
+        "RNN": ((mx.nd.array(rng.standard_normal((64, 32, 128))),
+                 mx.nd.array(rng.standard_normal(
+                     (4 * 256 * (128 + 256) + 8 * 256,))),
+                 mx.nd.zeros((1, 32, 256)), mx.nd.zeros((1, 32, 256))),
+                {"state_size": 256, "num_layers": 1, "mode": "lstm"}),
+    }
+    return specs.get(name)
+
+
+def bench_op(mx, name, iters=20, warmup=3):
+    spec = _inputs(mx, name)
+    if spec is None:
+        return None
+    args, kwargs = spec
+    fn = mx.nd.OP_REGISTRY[name]
+    out = fn(*args, **kwargs)
+    first = out[0] if isinstance(out, tuple) else out
+    first.wait_to_read()
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    (out[0] if isinstance(out, tuple) else out).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    (out[0] if isinstance(out, tuple) else out).wait_to_read()
+    fwd_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    # backward (only single-output float ops)
+    bwd_ms = None
+    from mxtpu import autograd
+    try:
+        diffable = [a for a in args]
+        for a in diffable:
+            a.attach_grad()
+        with autograd.record():
+            out = fn(*args, **kwargs)
+            first = out[0] if isinstance(out, tuple) else out
+            loss = first.sum()
+        loss.backward()
+        args[0].grad.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with autograd.record():
+                out = fn(*args, **kwargs)
+                first = out[0] if isinstance(out, tuple) else out
+                loss = first.sum()
+            loss.backward()
+        args[0].grad.wait_to_read()
+        bwd_ms = (time.perf_counter() - t0) / iters * 1e3
+    except Exception:
+        pass
+    return {"op": name, "fwd_ms": round(fwd_ms, 4),
+            "fwd_bwd_ms": round(bwd_ms, 4) if bwd_ms else None}
+
+
+DEFAULT_OPS = ["dot", "batch_dot", "FullyConnected", "Convolution",
+               "Pooling", "softmax", "BatchNorm", "LayerNorm", "sum",
+               "transpose", "broadcast_add", "relu", "sigmoid", "exp",
+               "topk", "sort", "take", "one_hot", "RNN"]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ops", default=None,
+                   help="comma-separated op names (default: curated set)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--json", default=None)
+    args = p.parse_args()
+    import mxtpu as mx
+    ops = args.ops.split(",") if args.ops else DEFAULT_OPS
+    results = []
+    print(f"{'op':<20}{'fwd (ms)':>12}{'fwd+bwd (ms)':>15}")
+    for name in ops:
+        r = bench_op(mx, name, args.iters)
+        if r is None:
+            print(f"{name:<20}{'(no spec)':>12}")
+            continue
+        results.append(r)
+        bwd = f"{r['fwd_bwd_ms']:.3f}" if r["fwd_bwd_ms"] else "-"
+        print(f"{r['op']:<20}{r['fwd_ms']:>12.3f}{bwd:>15}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

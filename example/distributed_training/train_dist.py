@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Data-parallel distributed training (reference
+``example/distributed_training/`` with kvstore dist_device_sync).
+
+Launch (the reference invocation, unchanged):
+    python tools/launch.py -n 2 --launcher local \
+        --env JAX_PLATFORMS=cpu -- python example/distributed_training/train_dist.py
+Each process computes grads on its batch shard; Trainer's kvstore
+all-reduces them (jax.distributed under the hood)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+# multi-process rendezvous must precede any jax backend use
+import jax  # noqa: E402
+import mxtpu as mx
+from mxtpu.parallel import dist as _dist
+_dist.initialize()
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+
+
+def main():
+    kv = mx.kv.create("dist_device_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    print(f"[rank {rank}/{nworker}] up", flush=True)
+
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((4, 16)) * 3.0
+    labels_all = rng.integers(0, 4, 2048)
+    data_all = (centers[labels_all] +
+                0.5 * rng.standard_normal((2048, 16))).astype(np.float32)
+    shard = slice(rank * 2048 // nworker, (rank + 1) * 2048 // nworker)
+    data, labels = data_all[shard], labels_all[shard].astype(np.float32)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(4))
+    mx.nd.random.seed(42)          # identical init on every rank
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    from mxtpu import io as mio
+    it = mio.NDArrayIter(data, labels, batch_size=64)
+    for epoch in range(5):
+        it.reset()
+        tot, n = 0.0, 0
+        for batch in it:
+            with autograd.record():
+                loss = loss_fn(net(batch.data[0]), batch.label[0]).mean()
+            loss.backward()
+            tr.step(64 * nworker)
+            tot += float(loss.asscalar())
+            n += 1
+        if rank == 0:
+            print(f"epoch {epoch} loss {tot/n:.4f}", flush=True)
+    if rank == 0:
+        assert tot / n < 0.5
+        print("dist training done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Gluon MNIST (reference ``example/gluon/mnist/mnist.py`` — BASELINE
+config 1). With no network access, synthesizes an MNIST-like dataset
+when the real files are absent (--data-dir can point at idx files)."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+
+
+def load_data(data_dir, batch_size):
+    from mxtpu import io as mio
+    img = os.path.join(data_dir or "", "train-images-idx3-ubyte.gz")
+    lab = os.path.join(data_dir or "", "train-labels-idx1-ubyte.gz")
+    if data_dir and os.path.exists(img):
+        return mio.MNISTIter(image=img, label=lab, batch_size=batch_size,
+                             shuffle=True), None
+    # synthetic stand-in: 10 noisy digit prototypes
+    rng = np.random.default_rng(0)
+    protos = rng.standard_normal((10, 1, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, 8192)
+    data = protos[labels] + 0.3 * rng.standard_normal(
+        (8192, 1, 28, 28)).astype(np.float32)
+    return mio.NDArrayIter(data, labels.astype(np.float32),
+                           batch_size=batch_size, shuffle=True), None
+
+
+def build_net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(32, 3, activation="relu"),
+                nn.MaxPool2D(2),
+                nn.Conv2D(64, 3, activation="relu"),
+                nn.MaxPool2D(2),
+                nn.Flatten(),
+                nn.Dense(128, activation="relu"),
+                nn.Dense(10))
+    return net
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.002)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    ctx = mx.cpu() if args.cpu else mx.tpu()
+
+    train_iter, _ = load_data(args.data_dir, args.batch_size)
+    net = build_net()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for batch in train_iter:
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y).mean()
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            n += args.batch_size
+        name, acc = metric.get()
+        print(f"Epoch {epoch}: {name}={acc:.4f} "
+              f"({n / (time.time() - tic):.0f} samples/s)")
+    assert acc > 0.9, "failed to fit"
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Inference throughput over the model zoo (reference
+``example/image-classification/benchmark_score.py`` — the img/s table
+in BASELINE.md)."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def score(mx, model, batch, size, iters=20):
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.get_model(model)
+    net.initialize(ctx=mx.tpu())
+    net.hybridize()
+    x = mx.nd.array(np.random.default_rng(0).standard_normal(
+        (batch, 3, size, size)).astype(np.float32), ctx=mx.tpu())
+    net(x).wait_to_read()          # compile
+    net(x).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = net(x)
+    y.wait_to_read()
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--models", default="resnet18_v1,resnet50_v1,"
+                   "mobilenetv2_1.0,squeezenet1.1")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--size", type=int, default=224)
+    args = p.parse_args()
+    import mxtpu as mx
+    for m in args.models.split(","):
+        ips = score(mx, m, args.batch, args.size)
+        print(f"{m:<20} batch={args.batch}  {ips:9.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

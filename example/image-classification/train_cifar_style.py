@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Module-based image classification (reference
+``example/image-classification/train_cifar10.py`` structure): symbolic
+net + Module.fit over an ImageRecordIter (synthetic .rec built on the
+fly if none given)."""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def synth_rec(path, n=256, size=32, classes=4):
+    from mxtpu import recordio
+    rng = np.random.default_rng(0)
+    w = recordio.MXIndexedRecordIO(
+        os.path.splitext(path)[0] + ".idx", path, "w")
+    for i in range(n):
+        cls = i % classes
+        img = rng.integers(0, 60, (size, size, 3)).astype(np.uint8)
+        img[:, :, cls % 3] += 160 + 60 * (cls // 3)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(cls), i, 0), img))
+    w.close()
+
+
+def build_symbol(mx, classes):
+    sym = mx.sym
+    data = sym.var("data")
+    net = sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                          name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, global_pool=True, pool_type="avg",
+                      kernel=(1, 1))
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=classes,
+                             name="fc")
+    return sym.SoftmaxOutput(net, name="softmax", normalization="batch")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rec", default=None)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    import mxtpu as mx
+    from mxtpu import io as mio
+    rec = args.rec
+    if rec is None:
+        rec = os.path.join(tempfile.mkdtemp(), "train.rec")
+        synth_rec(rec, classes=args.classes)
+    it = mio.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                             batch_size=args.batch_size, shuffle=True,
+                             mean_r=128, mean_g=128, mean_b=128,
+                             std_r=64, std_g=64, std_b=64)
+    mod = mx.mod.Module(build_symbol(mx, args.classes),
+                        context=mx.cpu() if args.cpu else mx.tpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+            eval_metric="acc", num_epoch=args.epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    score = dict(mod.score(it, "acc"))
+    print("final accuracy:", score["accuracy"])
+    assert score["accuracy"] > 0.9
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

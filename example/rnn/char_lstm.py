@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Char-level LSTM language model (reference ``example/rnn/``): learns
+to generate a repeating corpus; synthetic text built-in."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn, rnn
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    ctx = mx.cpu() if args.cpu else mx.tpu()
+
+    text = ("the quick brown fox jumps over the lazy dog. " * 50)
+    vocab = sorted(set(text))
+    stoi = {c: i for i, c in enumerate(vocab)}
+    ids = np.array([stoi[c] for c in text], np.int32)
+    T, B = args.seq_len, 16
+    n = (len(ids) - 1) // T * T
+    x = ids[:n].reshape(-1, T)[: (n // T // B) * B]
+    y = ids[1:n + 1].reshape(-1, T)[: (n // T // B) * B]
+
+    class CharLM(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.emb = nn.Embedding(len(vocab), 64)
+                self.lstm = rnn.LSTM(args.hidden, input_size=64,
+                                     layout="NTC")
+                self.out = nn.Dense(len(vocab), flatten=False,
+                                    in_units=args.hidden)
+
+        def hybrid_forward(self, F, tokens):
+            h = self.emb(tokens)
+            h = self.lstm(h)
+            return self.out(h)
+
+    net = CharLM()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.003})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    from mxtpu import io as mio
+    it = mio.NDArrayIter(x.astype(np.float32), y.astype(np.float32),
+                         batch_size=B, shuffle=True)
+    for epoch in range(args.epochs):
+        it.reset()
+        tot, nb = 0.0, 0
+        for batch in it:
+            bx = batch.data[0].as_in_context(ctx)
+            by = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                logits = net(bx)
+                loss = loss_fn(logits.reshape(-1, len(vocab)),
+                               by.reshape(-1)).mean()
+            loss.backward()
+            tr.step(B)
+            tot += float(loss.asscalar())
+            nb += 1
+        if epoch % 10 == 0:
+            print(f"epoch {epoch} loss {tot/nb:.4f}")
+    print(f"final loss {tot/nb:.4f}")
+    assert tot / nb < 0.5
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -74,12 +74,18 @@ class Context:
 
 
 def _devices_of_type(device_type: str) -> List[jax.Device]:
-    all_devs = jax.devices()
+    # LOCAL devices only: under multi-process (jax.distributed) a
+    # context must never resolve to another process's device — the
+    # reference's ctx list was per-worker too
+    all_devs = jax.local_devices()
     if device_type == "cpu":
+        cpus = [d for d in all_devs if d.platform == "cpu"]
+        if cpus:
+            return cpus
         try:
-            return jax.devices("cpu")
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
-            return [d for d in all_devs if d.platform == "cpu"]
+            return []
     # 'tpu' or 'gpu': any non-cpu accelerator (axon PJRT reports its own
     # platform name for TPU).
     accel = [d for d in all_devs if d.platform != "cpu"]

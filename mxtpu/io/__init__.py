@@ -374,11 +374,14 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
     if any(f"std_{c}" in kwargs for c in "rgb"):
         std = [kwargs.pop("std_r", 1.0), kwargs.pop("std_g", 1.0),
                kwargs.pop("std_b", 1.0)]
-    aug_keys = {k for k, v in kwargs.items()
-                if k.startswith("rand_") and v} | \
-        {k for k in kwargs if k in ("brightness", "contrast", "saturation",
-                                    "pca_noise", "resize") and kwargs[k]}
-    if not aug_keys and data_shape and data_shape[0] == 3:
+    # route natively only when EVERY remaining kwarg is semantics the
+    # C++ pipeline implements (decode + center-crop + resize + mean/std);
+    # anything else (label_width, hue, inter_method, augmenters, ...)
+    # goes through the Python ImageIter
+    native_ok_keys = {"seed", "data_name", "label_name"}
+    blocking = {k for k, v in kwargs.items()
+                if k not in native_ok_keys and v}
+    if not blocking and data_shape and data_shape[0] == 3:
         from .. import native
         if native.available():
             return NativeImageRecordIter(

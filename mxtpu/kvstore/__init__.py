@@ -18,6 +18,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as _onp
+
 from .. import ndarray as nd
 from ..base import MXNetError
 from ..ndarray import NDArray
@@ -83,7 +85,8 @@ class KVStore:
             # to a plain pull)
             rids = [None] * len(keys)
         elif isinstance(row_ids, (list, tuple)) and row_ids and \
-                not isinstance(row_ids[0], (list, tuple, NDArray)):
+                not isinstance(row_ids[0],
+                               (list, tuple, NDArray, _onp.ndarray)):
             # a flat python list of ids is ONE id set, not per-key lists
             rids = [row_ids] * len(keys)
         elif isinstance(row_ids, (list, tuple)):

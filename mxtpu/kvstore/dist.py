@@ -1,0 +1,85 @@
+"""Distributed KVStore (reference ``src/kvstore/kvstore_dist.h`` +
+ps-lite [path cites — unverified], SURVEY.md §2.5/§3.4).
+
+The reference's worker→server push / server→worker pull over ZMQ
+becomes an all-reduce across processes: ``push`` sums each key's value
+over every worker (process_allgather + sum — identical result on all
+ranks, no server role), ``pull`` reads the local aggregate. ``dist_async``
+keeps the API but is synchronous underneath (async PS updates have no
+TPU-native analogue; the reference docs themselves call the semantics
+statistical, SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from . import KVStore
+
+__all__ = ["DistKVStore"]
+
+
+class DistKVStore(KVStore):
+    def __init__(self, kv_type: str):
+        super().__init__(kv_type)
+        from ..parallel import dist
+        dist.initialize()
+
+    # -- cluster topology ---------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+    def barrier(self) -> None:
+        from jax.experimental import multihost_utils
+        if jax.process_count() > 1:
+            multihost_utils.sync_global_devices("mxtpu_kv_barrier")
+
+    # -- reduction ----------------------------------------------------------
+    def _allreduce(self, value: NDArray) -> NDArray:
+        if jax.process_count() == 1:
+            return value
+        import jax.numpy as jnp
+        import numpy as _onp
+        from jax.experimental import multihost_utils
+        # gather host copies: per-process local arrays can carry device
+        # placements process_allgather's jit path rejects; the host hop
+        # is the KVStore compatibility veneer — the fast path for dense
+        # training is the jitted psum step (mxtpu.parallel)
+        gathered = multihost_utils.process_allgather(
+            _onp.asarray(value._data))
+        return NDArray(jnp.asarray(gathered.sum(axis=0)))
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            agg = vals[0]
+            for extra in vals[1:]:
+                agg = agg + extra
+            agg = self._allreduce(agg)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                self._store[k] = agg.copy()
+
+    def allreduce_grads(self, params) -> None:
+        """Trainer hook: SUM grads across workers in place (reference
+        dist kvstore semantics — Trainer.step's global batch size then
+        normalizes once)."""
+        for p in params:
+            if p.grad_req == "null" or p._data is None:
+                continue
+            g = p.grad()
+            red = self._allreduce(g)
+            g._set_data(red._data)

@@ -46,11 +46,25 @@ def initialize(coordinator_address: Optional[str] = None,
     if coordinator_address is None and num_processes in (None, 1):
         _initialized = True  # single-process: nothing to rendezvous
         return
+    # CPU multi-process needs two programmatic settings: the platform
+    # (the ambient sitecustomize overrides the JAX_PLATFORMS env var)
+    # and the cross-process collectives impl (gloo) — without the
+    # latter every process stays a world of its own
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         local_device_ids=local_device_ids)
+    # pin this process's computations to ITS device: otherwise
+    # uncommitted arrays jit onto global device 0 and every other rank
+    # holds non-addressable results
+    jax.config.update("jax_default_device", jax.local_devices()[0])
     global _client_started
     _client_started = True
     _initialized = True

@@ -23,6 +23,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <random>
 #include <string>
@@ -158,6 +159,7 @@ void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, float* dst,
 struct Sample {
   std::vector<float> data;   // h*w*c
   float label = 0.f;
+  bool valid = false;        // skip markers keep the sequence contiguous
 };
 
 struct Pipeline {
@@ -167,49 +169,78 @@ struct Pipeline {
   uint32_t seed, epoch = 0;
   std::vector<uint32_t> order;
   std::atomic<size_t> next_idx{0};
-  // bounded queue
-  std::deque<Sample> queue;
+  // ordered bounded buffer: samples are emitted in `order` sequence so
+  // shuffle=False keeps file order regardless of worker scheduling
+  std::map<uint32_t, Sample> ready;
+  size_t next_emit = 0;
   std::mutex mu;
   std::condition_variable cv_push, cv_pop;
   size_t max_queue = 64;
   int nthreads = 1;
   std::vector<std::thread> workers;
   std::atomic<bool> stop{false};
-  std::atomic<int> active_workers{0};
+  int active_workers = 0;    // guarded by mu
+
+  void Emit(uint32_t seq, Sample&& s) {
+    std::unique_lock<std::mutex> lk(mu);
+    // always admit the sequence the consumer is waiting for, so a full
+    // buffer of later samples cannot deadlock against it
+    cv_push.wait(lk, [&] {
+      return ready.size() < max_queue || seq == next_emit || stop.load();
+    });
+    if (stop.load()) return;
+    ready.emplace(seq, std::move(s));
+    cv_pop.notify_all();
+  }
 
   void WorkerLoop() {
     std::vector<uint8_t> record, pixels;
     while (!stop.load()) {
       size_t i = next_idx.fetch_add(1);
       if (i >= order.size()) break;
+      Sample s;                 // default: invalid (skip marker)
       uint64_t pos = rec.offsets[order[i]];
-      if (!ReadRecord(rec.fd, &pos, &record)) break;
-      // IRHeader: uint32 flag, float label, uint64 id[2]
-      if (record.size() < 24) continue;
-      uint32_t flag;
-      float label;
-      memcpy(&flag, record.data(), 4);
-      memcpy(&label, record.data() + 4, 4);
-      size_t off = 24 + (size_t)flag * 4;   // ext labels skipped
-      if (off >= record.size()) continue;   // bounds BEFORE ext read
-      if (flag > 0) memcpy(&label, record.data() + 24, 4);
-      int dw, dh, dc;
-      if (DecodeJpeg(record.data() + off, record.size() - off, c,
-                     &pixels, &dw, &dh, &dc))
-        continue;                            // undecodable: skip
-      Sample s;
-      s.label = label;
-      s.data.resize((size_t)h * w * c);
-      ResizeBilinear(pixels.data(), dh, dw, dc, s.data.data(), h, w);
-      std::unique_lock<std::mutex> lk(mu);
-      cv_push.wait(lk, [&] {
-        return queue.size() < max_queue || stop.load();
-      });
-      if (stop.load()) break;
-      queue.push_back(std::move(s));
-      cv_pop.notify_one();
+      if (ReadRecord(rec.fd, &pos, &record) && record.size() >= 24) {
+        // IRHeader: uint32 flag, float label, uint64 id[2]
+        uint32_t flag;
+        float label;
+        memcpy(&flag, record.data(), 4);
+        memcpy(&label, record.data() + 4, 4);
+        size_t off = 24 + (size_t)flag * 4;   // ext labels skipped
+        if (off < record.size()) {
+          if (flag > 0) memcpy(&label, record.data() + 24, 4);
+          int dw, dh, dc;
+          if (!DecodeJpeg(record.data() + off, record.size() - off, c,
+                          &pixels, &dw, &dh, &dc)) {
+            s.label = label;
+            s.valid = true;
+            s.data.resize((size_t)h * w * c);
+            // python-path parity (CenterCropAug): crop the centered
+            // min(src,target) region, then bilinear-resize
+            int ch = dh < h ? dh : h;
+            int cw = dw < w ? dw : w;
+            int y0 = (dh - ch) / 2, x0 = (dw - cw) / 2;
+            if (ch == dh && cw == dw) {
+              ResizeBilinear(pixels.data(), dh, dw, dc, s.data.data(),
+                             h, w);
+            } else {
+              std::vector<uint8_t> crop((size_t)ch * cw * dc);
+              for (int y = 0; y < ch; ++y)
+                memcpy(crop.data() + (size_t)y * cw * dc,
+                       pixels.data() +
+                           ((size_t)(y0 + y) * dw + x0) * dc,
+                       (size_t)cw * dc);
+              ResizeBilinear(crop.data(), ch, cw, dc, s.data.data(),
+                             h, w);
+            }
+          }
+        }
+      }
+      Emit((uint32_t)i, std::move(s));
     }
-    if (active_workers.fetch_sub(1) == 1) cv_pop.notify_all();
+    std::lock_guard<std::mutex> lk(mu);   // race-free final wakeup
+    --active_workers;
+    cv_pop.notify_all();
   }
 
   void Start(int nthreads) {
@@ -223,20 +254,27 @@ struct Pipeline {
       }
     }
     next_idx = 0;
+    next_emit = 0;
     stop = false;
-    active_workers = nthreads;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      active_workers = nthreads;
+    }
     for (int t = 0; t < nthreads; ++t)
       workers.emplace_back([this] { WorkerLoop(); });
   }
 
   void Stop() {
-    stop = true;
+    {
+      std::lock_guard<std::mutex> lk(mu);   // no lost wakeups
+      stop = true;
+    }
     cv_push.notify_all();
     cv_pop.notify_all();
     for (auto& t : workers) t.join();
     workers.clear();
     std::lock_guard<std::mutex> lk(mu);
-    queue.clear();
+    ready.clear();
   }
 };
 
@@ -328,13 +366,22 @@ long mxtpu_pipe_next(void* h, long batch, float* data, float* labels) {
   while (filled < batch) {
     std::unique_lock<std::mutex> lk(p->mu);
     p->cv_pop.wait(lk, [&] {
-      return !p->queue.empty() || p->active_workers.load() == 0;
+      return p->ready.count((uint32_t)p->next_emit) ||
+             p->active_workers == 0;
     });
-    if (p->queue.empty()) break;             // workers done + drained
-    Sample s = std::move(p->queue.front());
-    p->queue.pop_front();
+    auto it = p->ready.find((uint32_t)p->next_emit);
+    if (it == p->ready.end()) {
+      // workers finished; skip over any hole a dying worker left
+      if (p->ready.empty()) break;
+      it = p->ready.begin();
+      p->next_emit = it->first;
+    }
+    Sample s = std::move(it->second);
+    p->ready.erase(it);
+    ++p->next_emit;
     lk.unlock();
-    p->cv_push.notify_one();
+    p->cv_push.notify_all();
+    if (!s.valid) continue;                  // skipped record
     memcpy(data + filled * sample_sz, s.data.data(),
            sample_sz * sizeof(float));
     labels[filled] = s.label;

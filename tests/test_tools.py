@@ -1,0 +1,102 @@
+"""Tools tests: parse_log, launch.py local tracker + dist kvstore
+invariants (the reference's tests/nightly/dist_sync_kvstore.py pattern:
+the local tracker forks workers on one host, SURVEY.md §4.2)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+
+
+def test_parse_log():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    rows = parse_log.parse([
+        "INFO:root:Epoch[0] Train-accuracy=0.5",
+        "INFO:root:Epoch[0] Time cost=1.25",
+        "INFO:root:Epoch[1] Train-accuracy=0.75",
+        "INFO:root:Epoch[1] Validation-accuracy=0.7",
+    ])
+    assert rows[0]["train-accuracy"] == 0.5
+    assert rows[0]["time"] == 1.25
+    assert rows[1]["validation-accuracy"] == 0.7
+
+
+def test_launch_local_env_wiring(tmp_path):
+    worker = tmp_path / "worker.py"
+    # write to per-rank files: concurrent stdout interleaves
+    worker.write_text(textwrap.dedent(f"""
+        import os
+        rank = os.environ["DMLC_WORKER_ID"]
+        with open({str(tmp_path)!r} + "/rank" + rank, "w") as f:
+            f.write(os.environ["DMLC_NUM_WORKER"] + " " +
+                    os.environ["DMLC_PS_ROOT_URI"])
+    """))
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "3", "--launcher", "local", "--",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for rank in range(3):
+        content = (tmp_path / f"rank{rank}").read_text().split()
+        assert content[0] == "3"
+        assert content[1] == "127.0.0.1"
+
+
+@pytest.mark.slow
+def test_dist_sync_kvstore_invariants(tmp_path):
+    """After a synchronized push from W workers, the pulled value is
+    W * grad (reference dist_sync_kvstore.py assertion)."""
+    worker = tmp_path / "kv_worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import mxtpu as mx
+        from mxtpu.parallel import dist
+        dist.initialize()
+        import numpy as np
+        kv = mx.kv.create("dist_sync")
+        rank, W = kv.rank, kv.num_workers
+        assert W == 2, W
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.push("w", mx.nd.ones((4,)) * (rank + 1))   # 1 + 2 = 3
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        expected = 3.0
+        assert np.allclose(out.asnumpy(), expected), out.asnumpy()
+        kv.barrier()
+        print("KVOK", rank, flush=True)
+    """))
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--env", "JAX_PLATFORMS=cpu", "--",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    assert out.stdout.count("KVOK") == 2
+
+
+def test_opperf_runs():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "opperf",
+                                      "opperf.py"),
+         "--ops", "relu,sum", "--iters", "3"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-1000:]
+    assert "relu" in out.stdout
+
+
+def test_im2rec_exists_and_diagnose():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "mxtpu version" in out.stdout

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Allreduce bandwidth probe (reference ``tools/bandwidth/measure.py``
+[path cite — unverified], a BASELINE.json metric).
+
+Times psum over the local device mesh for a range of sizes and reports
+algorithmic bandwidth (2(n-1)/n * bytes / time for a ring). On one chip
+the collective is the identity; the probe then reports device memory
+bandwidth of the copy, still useful as a smoke number.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def measure(sizes_mb, iters=10):
+    devs = jax.devices()
+    n = len(devs)
+    mesh = jax.sharding.Mesh(np.array(devs), ("x",))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @jax.jit
+    def allreduce(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P())) * 1.0
+
+    def psum_fn(x):
+        return jax.lax.psum(x, "x")
+    shard = jax.shard_map(psum_fn, mesh=mesh, in_specs=P("x"),
+                          out_specs=P())
+    jshard = jax.jit(shard)
+
+    rows = []
+    for mb in sizes_mb:
+        elems = int(mb * 1024 * 1024 / 4)
+        elems = max(elems - elems % n, n)
+        x = jax.device_put(
+            jnp.ones((elems,), jnp.float32),
+            NamedSharding(mesh, P("x")))
+        jshard(x).block_until_ready()          # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jshard(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        nbytes = elems * 4
+        algo_bw = (2 * (n - 1) / max(n, 1)) * nbytes / dt / 1e9 \
+            if n > 1 else nbytes / dt / 1e9
+        rows.append((mb, dt * 1e3, algo_bw))
+        print(f"size {mb:8.2f} MB  time {dt*1e3:8.3f} ms  "
+              f"busbw {algo_bw:8.2f} GB/s")
+    return rows
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", default="1,4,16,64,256")
+    p.add_argument("--iters", type=int, default=10)
+    a = p.parse_args()
+    print(f"devices: {jax.devices()}")
+    measure([float(s) for s in a.sizes.split(",")], a.iters)

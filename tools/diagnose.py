@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Environment diagnostics (reference ``tools/diagnose.py``)."""
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    print("----------Python Info----------")
+    print("version:", sys.version.replace("\n", " "))
+    print("platform:", platform.platform())
+    print("----------mxtpu Info----------")
+    import mxtpu as mx
+    print("mxtpu version:", mx.__version__)
+    import jax
+    print("jax:", jax.__version__)
+    print("devices:", jax.devices())
+    print("features:", mx.runtime.Features())
+    from mxtpu import native
+    print("libmxtpu native:", native.available())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Re-run a test many times over random seeds (reference
+``tools/flakiness_checker.py``): flaky tests fail intermittently."""
+import argparse
+import random
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("test", help="pytest node id, e.g. tests/test_x.py::t")
+    p.add_argument("-n", "--trials", type=int, default=20)
+    p.add_argument("--seed", type=int, default=None)
+    a = p.parse_args()
+    rng = random.Random(a.seed)
+    failures = 0
+    for i in range(a.trials):
+        seed = rng.randrange(2 ** 31)
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", a.test, "-x", "-q"],
+            env={**__import__("os").environ,
+                 "MXNET_TEST_SEED": str(seed)},
+            capture_output=True, text=True)
+        status = "PASS" if r.returncode == 0 else "FAIL"
+        if r.returncode != 0:
+            failures += 1
+            print(f"trial {i} seed {seed}: FAIL")
+            print(r.stdout[-2000:])
+        else:
+            print(f"trial {i} seed {seed}: PASS")
+    print(f"{failures}/{a.trials} failures")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

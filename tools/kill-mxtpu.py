@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Kill stray training processes (reference ``tools/kill-mxnet.py``)."""
+import argparse
+import os
+import signal
+import subprocess
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("pattern", nargs="?", default="mxtpu",
+                   help="substring of the command line to kill")
+    a = p.parse_args()
+    out = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                         text=True).stdout
+    me = os.getpid()
+    for line in out.splitlines()[1:]:
+        parts = line.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, cmd = int(parts[0]), parts[1]
+        if a.pattern in cmd and pid != me and "kill-mxtpu" not in cmd:
+            print(f"killing {pid}: {cmd[:80]}")
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+
+if __name__ == "__main__":
+    main()

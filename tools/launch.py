@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference ``tools/launch.py`` + dmlc
+tracker [path cites — unverified]).
+
+Reference protocol: 1 scheduler + S servers + W workers wired via
+DMLC_* env vars. TPU-native: W equal processes rendezvous at a
+jax.distributed coordinator; the DMLC_* names are kept so reference
+invocations port verbatim:
+
+    python tools/launch.py -n 4 --launcher local python train.py
+
+Launchers: local (fork N processes on this host) and ssh (one process
+per host from --host-file).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args, command):
+    port = args.port or _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+        })
+        if args.env:
+            for kv in args.env:
+                k, _, v = kv.partition("=")
+                env[k] = v
+        procs.append(subprocess.Popen(command, env=env))
+    code = 0
+
+    def _kill(*_):
+        for p in procs:
+            p.terminate()
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def launch_ssh(args, command):
+    with open(args.host_file) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    if len(hosts) < args.num_workers:
+        raise SystemExit(f"need {args.num_workers} hosts, have "
+                         f"{len(hosts)} in {args.host_file}")
+    port = args.port or 9091
+    coord = hosts[0]
+    procs = []
+    for rank in range(args.num_workers):
+        envs = " ".join([
+            f"DMLC_ROLE=worker",
+            f"DMLC_PS_ROOT_URI={coord}",
+            f"DMLC_PS_ROOT_PORT={port}",
+            f"DMLC_NUM_WORKER={args.num_workers}",
+            f"DMLC_WORKER_ID={rank}",
+        ] + (args.env or []))
+        cmd = f"cd {os.getcwd()} && {envs} {' '.join(command)}"
+        procs.append(subprocess.Popen(["ssh", hosts[rank], cmd]))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-s", "--num-servers", type=int, default=0,
+                   help="accepted for reference CLI parity (the "
+                        "all-reduce design has no server role)")
+    p.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    p.add_argument("-H", "--host-file", help="hosts for --launcher ssh")
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--env", nargs="*", help="extra KEY=VALUE to export")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        raise SystemExit("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args, args.command))
+    sys.exit(launch_ssh(args, args.command))
+
+
+if __name__ == "__main__":
+    main()

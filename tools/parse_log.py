@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference ``tools/parse_log.py``):
+extracts Epoch[k] Train-<metric>/Validation-<metric>/Time cost lines."""
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    rows = {}
+    pat = re.compile(
+        r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([\d.eE+-]+)")
+    tpat = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+    for line in lines:
+        m = pat.search(line)
+        if m:
+            ep = int(m.group(1))
+            rows.setdefault(ep, {})[
+                f"{m.group(2).lower()}-{m.group(3)}"] = float(m.group(4))
+        m = tpat.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile", nargs="?", default="-")
+    a = p.parse_args()
+    f = sys.stdin if a.logfile == "-" else open(a.logfile)
+    rows = parse(f)
+    cols = sorted({c for r in rows.values() for c in r})
+    print("epoch\t" + "\t".join(cols))
+    for ep in sorted(rows):
+        print(f"{ep}\t" + "\t".join(
+            f"{rows[ep].get(c, float('nan')):.6g}" for c in cols))
+
+
+if __name__ == "__main__":
+    main()
