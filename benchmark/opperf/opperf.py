@@ -22,45 +22,66 @@ import numpy as onp  # noqa: E402
 
 
 def _inputs(mx, name):
-    """Synthetic inputs per op category (reference DEFAULT_* shapes)."""
+    """Synthetic inputs per op category (reference DEFAULT_* shapes).
+    Thunks: only the requested op's tensors materialize."""
     rng = onp.random.default_rng(0)
-    big = mx.nd.array(rng.standard_normal((1024, 1024)).astype("float32"))
-    vec = mx.nd.array(rng.standard_normal((1024 * 1024,)).astype("float32"))
-    img = mx.nd.array(rng.standard_normal((32, 3, 64, 64)).astype("float32"))
-    w = mx.nd.array(rng.standard_normal((16, 3, 3, 3)).astype("float32"))
-    fcw = mx.nd.array(rng.standard_normal((256, 1024)).astype("float32"))
+
+    def big():
+        return mx.nd.array(rng.standard_normal((1024, 1024))
+                           .astype("float32"))
+
+    def vec():
+        return mx.nd.array(rng.standard_normal((1024 * 1024,))
+                           .astype("float32"))
+
+    def img():
+        return mx.nd.array(rng.standard_normal((32, 3, 64, 64))
+                           .astype("float32"))
+
     specs = {
-        "dot": ((big, big), {}),
-        "batch_dot": ((mx.nd.array(rng.standard_normal((32, 128, 128))),
-                       mx.nd.array(rng.standard_normal((32, 128, 128)))), {}),
-        "FullyConnected": ((big, fcw), {"num_hidden": 256}),
-        "Convolution": ((img, w), {"kernel": (3, 3), "num_filter": 16,
-                                   "pad": (1, 1)}),
-        "Pooling": ((img,), {"kernel": (2, 2), "stride": (2, 2),
-                             "pool_type": "max"}),
-        "softmax": ((big,), {}),
-        "BatchNorm": ((img, mx.nd.ones((3,)), mx.nd.zeros((3,)),
-                       mx.nd.zeros((3,)), mx.nd.ones((3,))), {}),
-        "LayerNorm": ((big, mx.nd.ones((1024,)), mx.nd.zeros((1024,))), {}),
-        "sum": ((big,), {}),
-        "transpose": ((big,), {}),
-        "broadcast_add": ((big, big), {}),
-        "relu": ((vec,), {}),
-        "sigmoid": ((vec,), {}),
-        "exp": ((vec,), {}),
-        "topk": ((big,), {"k": 10}),
-        "sort": ((vec,), {}),
-        "take": ((big, mx.nd.array(rng.integers(0, 1024, 4096)
-                                   .astype("float32"))), {}),
-        "one_hot": ((mx.nd.array(rng.integers(0, 128, 8192)
-                                 .astype("float32")),), {"depth": 128}),
-        "RNN": ((mx.nd.array(rng.standard_normal((64, 32, 128))),
-                 mx.nd.array(rng.standard_normal(
-                     (4 * 256 * (128 + 256) + 8 * 256,))),
-                 mx.nd.zeros((1, 32, 256)), mx.nd.zeros((1, 32, 256))),
-                {"state_size": 256, "num_layers": 1, "mode": "lstm"}),
+        "dot": lambda: ((big(), big()), {}),
+        "batch_dot": lambda: (
+            (mx.nd.array(rng.standard_normal((32, 128, 128))),
+             mx.nd.array(rng.standard_normal((32, 128, 128)))), {}),
+        "FullyConnected": lambda: (
+            (big(), mx.nd.array(rng.standard_normal((256, 1024))
+                                .astype("float32"))),
+            {"num_hidden": 256}),
+        "Convolution": lambda: (
+            (img(), mx.nd.array(rng.standard_normal((16, 3, 3, 3))
+                                .astype("float32"))),
+            {"kernel": (3, 3), "num_filter": 16, "pad": (1, 1)}),
+        "Pooling": lambda: ((img(),), {"kernel": (2, 2), "stride": (2, 2),
+                                       "pool_type": "max"}),
+        "softmax": lambda: ((big(),), {}),
+        "BatchNorm": lambda: (
+            (img(), mx.nd.ones((3,)), mx.nd.zeros((3,)),
+             mx.nd.zeros((3,)), mx.nd.ones((3,))), {}),
+        "LayerNorm": lambda: (
+            (big(), mx.nd.ones((1024,)), mx.nd.zeros((1024,))), {}),
+        "sum": lambda: ((big(),), {}),
+        "transpose": lambda: ((big(),), {}),
+        "broadcast_add": lambda: ((big(), big()), {}),
+        "relu": lambda: ((vec(),), {}),
+        "sigmoid": lambda: ((vec(),), {}),
+        "exp": lambda: ((vec(),), {}),
+        "topk": lambda: ((big(),), {"k": 10}),
+        "sort": lambda: ((vec(),), {}),
+        "take": lambda: (
+            (big(), mx.nd.array(rng.integers(0, 1024, 4096)
+                                .astype("float32"))), {}),
+        "one_hot": lambda: (
+            (mx.nd.array(rng.integers(0, 128, 8192).astype("float32")),),
+            {"depth": 128}),
+        "RNN": lambda: (
+            (mx.nd.array(rng.standard_normal((64, 32, 128))),
+             mx.nd.array(rng.standard_normal(
+                 (4 * 256 * (128 + 256) + 8 * 256,))),
+             mx.nd.zeros((1, 32, 256)), mx.nd.zeros((1, 32, 256))),
+            {"state_size": 256, "num_layers": 1, "mode": "lstm"}),
     }
-    return specs.get(name)
+    thunk = specs.get(name)
+    return thunk() if thunk is not None else None
 
 
 def bench_op(mx, name, iters=20, warmup=3):

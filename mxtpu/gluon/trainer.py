@@ -40,6 +40,7 @@ class Trainer:
         self._kvstore = None
         self._kv_initialized = False
         self._kvstore_type = kvstore
+        self._compression_params = compression_params
         self._contains_sparse = False
 
     @property
@@ -61,6 +62,9 @@ class Trainer:
                 self._kvstore = kv.create(self._kvstore_type)
         else:
             self._kvstore = self._kvstore_type
+        if self._kvstore is not None and self._compression_params:
+            self._kvstore.set_gradient_compression(
+                self._compression_params)
         self._kv_initialized = True
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:

@@ -53,6 +53,9 @@ class KVStore:
             agg = vals[0]
             for extra in vals[1:]:
                 agg = agg + extra
+            comp = getattr(self, "_compression", None)
+            if comp is not None:
+                agg = comp.decompress(k, comp.compress(k, agg))
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
             else:
@@ -129,8 +132,10 @@ class KVStore:
         self._updater = opt.get_updater(self._optimizer)
 
     def set_gradient_compression(self, compression_params) -> None:
-        # ICI bandwidth makes 2-bit compression a non-goal; API preserved
-        self._compression = dict(compression_params)
+        """Enable 2-bit gradient compression on pushes (reference
+        ``KVStore.set_gradient_compression``)."""
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**dict(compression_params))
 
     # -- cluster topology (single-process values) ----------------------------
     @property

@@ -67,6 +67,12 @@ class DistKVStore(KVStore):
             agg = vals[0]
             for extra in vals[1:]:
                 agg = agg + extra
+            comp = getattr(self, "_compression", None)
+            if comp is not None:
+                # compress on the wire (reference kvstore_dist +
+                # gradient_compression.cc): quantize locally with error
+                # feedback, reduce the ternary values
+                agg = comp.decompress(k, comp.compress(k, agg))
             agg = self._allreduce(agg)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
@@ -76,10 +82,14 @@ class DistKVStore(KVStore):
     def allreduce_grads(self, params) -> None:
         """Trainer hook: SUM grads across workers in place (reference
         dist kvstore semantics — Trainer.step's global batch size then
-        normalizes once)."""
+        normalizes once). Applies 2-bit wire compression when set."""
+        comp = getattr(self, "_compression", None)
         for p in params:
             if p.grad_req == "null" or p._data is None:
                 continue
             g = p.grad()
-            red = self._allreduce(g)
+            src = g
+            if comp is not None:
+                src = comp.decompress(p.name, comp.compress(p.name, g))
+            red = self._allreduce(src)
             g._set_data(red._data)

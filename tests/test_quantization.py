@@ -1,0 +1,120 @@
+"""Quantization + gradient compression tests (reference
+tests/python/quantization/test_quantization.py patterns)."""
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu.contrib import quantization as q
+from mxtpu import io as mio
+
+sym = mx.sym
+
+
+def test_quantize_dequantize_round_trip():
+    x = mx.nd.array(onp.linspace(-3, 3, 101).astype(onp.float32))
+    qx, lo, hi = q.quantize(x)
+    assert qx.dtype == onp.int8
+    back = q.dequantize(qx, lo, hi)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                                atol=3.0 / 127 + 1e-6)
+
+
+def test_quantized_fc_close_to_fp32():
+    rng = onp.random.default_rng(0)
+    x = mx.nd.array(rng.standard_normal((8, 32)).astype(onp.float32))
+    w = rng.standard_normal((16, 32)).astype(onp.float32)
+    b = rng.standard_normal((16,)).astype(onp.float32)
+    ref = x.asnumpy() @ w.T + b
+    qw, w_thr = q._quantize_weight(w)
+    out = q.quantized_fully_connected(
+        x, mx.nd.array(qw, dtype="int8"), mx.nd.array(b),
+        num_hidden=16, w_thr=w_thr)
+    err = onp.abs(out.asnumpy() - ref) / (onp.abs(ref).mean() + 1e-6)
+    assert err.mean() < 0.05, err.mean()
+
+
+def _mlp_and_params(seed=0):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    rng = onp.random.default_rng(seed)
+    args = {"fc1_weight": mx.nd.array(rng.standard_normal((32, 16)) * 0.3),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.array(rng.standard_normal((4, 32)) * 0.3),
+            "fc2_bias": mx.nd.zeros((4,))}
+    return net, args
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_model(calib_mode):
+    net, args = _mlp_and_params()
+    rng = onp.random.default_rng(1)
+    calib = mio.NDArrayIter(
+        rng.standard_normal((64, 16)).astype(onp.float32), None,
+        batch_size=16) if calib_mode != "none" else None
+    qsym, qargs, _ = q.quantize_model(
+        net, args, {}, calib_mode=calib_mode, calib_data=calib,
+        ctx=mx.cpu())
+    assert qargs["fc1_weight"].dtype == onp.int8
+    ops = {n.op for n in qsym._topo()}
+    assert "_contrib_quantized_fully_connected" in ops
+
+    x = mx.nd.array(rng.standard_normal((8, 16)).astype(onp.float32))
+    ex_f = net.bind(mx.cpu(), {**args, "data": x}, grad_req="null")
+    ref = ex_f.forward()[0].asnumpy()
+    ex_q = qsym.bind(mx.cpu(), {**qargs, "data": x}, grad_req="null")
+    out = ex_q.forward()[0].asnumpy()
+    rel = onp.abs(out - ref).mean() / (onp.abs(ref).mean() + 1e-6)
+    assert rel < 0.1, (calib_mode, rel)
+
+
+def test_quantize_model_excluded():
+    net, args = _mlp_and_params()
+    qsym, qargs, _ = q.quantize_model(
+        net, args, {}, excluded_sym_names=("fc2",))
+    ops = {n.op: n for n in qsym._topo()}
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "FullyConnected" in ops               # fc2 stays fp32
+    assert qargs["fc2_weight"].dtype == onp.float32
+
+
+def test_quantize_net_gluon(tmp_path):
+    from mxtpu.gluon import nn
+    rng = onp.random.default_rng(2)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(rng.standard_normal((4, 8)).astype(onp.float32))
+    ref = net(x).asnumpy()
+    calib = mio.NDArrayIter(rng.standard_normal((32, 8)).astype(
+        onp.float32), None, batch_size=8)
+    qnet = q.quantize_net(net, calib_data=calib)
+    out = qnet(x).asnumpy()
+    rel = onp.abs(out - ref).mean() / (onp.abs(ref).mean() + 1e-6)
+    assert rel < 0.1, rel
+
+
+def test_gradient_compression_round_trip():
+    from mxtpu.kvstore.gradient_compression import GradientCompression
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = mx.nd.array(onp.array([0.9, -0.7, 0.1, -0.2, 0.45]))
+    c = gc.compress("k", g)
+    assert set(onp.unique(c.asnumpy())) <= {-0.5, 0.0, 0.5}
+    # error feedback: residual carries the difference
+    onp.testing.assert_allclose(
+        gc._residual["k"], [0.4, -0.2, 0.1, -0.2, 0.45], rtol=1e-6)
+    # second push: accumulated small values eventually fire
+    c2 = gc.compress("k", g)
+    assert c2.asnumpy()[4] == 0.5      # 0.45+0.45 ≥ 0.5
+
+
+def test_kvstore_with_compression():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.push("w", mx.nd.array([1.0, 0.2, -0.8, 0.0]))
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5, 0.0])

@@ -23,11 +23,6 @@ def measure(sizes_mb, iters=10):
     mesh = jax.sharding.Mesh(np.array(devs), ("x",))
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    @jax.jit
-    def allreduce(x):
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P())) * 1.0
-
     def psum_fn(x):
         return jax.lax.psum(x, "x")
     shard = jax.shard_map(psum_fn, mesh=mesh, in_specs=P("x"),

@@ -56,9 +56,20 @@ def launch_local(args, command):
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
+    # poll all workers: a crashed rank must take the job down, not hang
+    # the survivors inside the rendezvous
+    import time
+    live = list(procs)
+    while live:
+        for p in list(live):
+            rc = p.poll()
+            if rc is not None:
+                live.remove(p)
+                code = code or rc
+                if rc != 0:
+                    for q in live:
+                        q.terminate()
+        time.sleep(0.2)
     return code
 
 
