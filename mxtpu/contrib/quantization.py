@@ -238,9 +238,7 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
         internals = sym.get_internals()
         input_names = {}
         for node in targets:
-            src = node.inputs[0][0]
-            nm = src.name if src.is_var() else src.name
-            input_names[node.name] = nm
+            input_names[node.name] = node.inputs[0][0].name
         uniq = sorted({v for v in input_names.values()
                        if v not in data_names})
         ths = calib_thresholds(sym, arg_params, aux_params, calib_data,
@@ -261,7 +259,12 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
             wname = node.inputs[1][0].name
             w = arg_params[wname].asnumpy()
             qw, w_thr = _quantize_weight(w)
-            qarg_params[wname] = nd.array(qw, dtype="int8")
+            # int8 codes live under a NEW arg name — a weight shared
+            # with a non-quantized consumer keeps its fp32 entry
+            qwname = wname + "_quantized"
+            qarg_params[qwname] = nd.array(qw, dtype="int8")
+            qw_var = _Node("null", qwname, {}, [])
+            new_inputs = ([new_inputs[0], (qw_var, 0)] + new_inputs[2:])
             attrs = dict(node.attrs)
             attrs["w_thr"] = w_thr
             if node.name in th_dict:
@@ -275,7 +278,12 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
         return new
 
     entries = [(clone(n), i) for n, i in sym._entries]
-    return Symbol(entries), qarg_params, dict(aux_params)
+    qsym = Symbol(entries)
+    # drop args no longer referenced (fp32 copies of fully-quantized
+    # weights), keep everything the rewritten graph consumes
+    live = set(qsym.list_inputs())
+    qarg_params = {k: v for k, v in qarg_params.items() if k in live}
+    return qsym, qarg_params, dict(aux_params)
 
 
 def quantize_net(network, calib_data=None, calib_mode="naive",

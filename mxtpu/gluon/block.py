@@ -428,7 +428,10 @@ class HybridBlock(Block):
             return (tuple(o._data if isinstance(o, NDArray) else o
                           for o in flat_out), tuple(aux_vals))
 
-        return jax.jit(raw)
+        jitted = jax.jit(raw)
+        # stable across steps → autograd caches one jitted backward
+        jitted._mx_cache_vjp = True
+        return jitted
 
     # -- symbolic tracing / deploy ------------------------------------------
     def _symbolic_call(self, *args):

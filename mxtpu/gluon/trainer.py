@@ -106,6 +106,7 @@ class Trainer:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         updater = self._updaters[0]
+        live = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -114,6 +115,22 @@ class Trainer:
                     continue
                 raise MXNetError(
                     f"parameter {p.name} not initialized before step()")
+            live.append((i, p))
+        # fused multi-tensor path: ONE XLA program for all params (the
+        # reference's multi_sgd/multi_lamb ops); falls back per-param
+        fused = getattr(self._optimizer, "fused_step", None)
+        if fused is not None and live:
+            for i, p in live:
+                if i not in updater.states:
+                    updater.states[i] = \
+                        self._optimizer.create_state_multi_precision(
+                            i, p.data())
+            if fused([i for i, _ in live],
+                     [p.data() for _, p in live],
+                     [p.grad() for _, p in live],
+                     [updater.states[i] for i, _ in live]):
+                return
+        for i, p in live:
             updater(i, p.grad(), p.data())
 
     def zero_grad(self) -> None:

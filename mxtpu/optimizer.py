@@ -234,6 +234,74 @@ class SGD(Optimizer):
         weight._set_data(new_w)
         state._set_data(new_mom)
 
+    def fused_step(self, indices, weights, grads, states):
+        return _fused_sgd_step(self, indices, weights, grads, states)
+
+
+def _fused_adam(ws, ms, vs, gs, lr_ts, wds, rs, b1, b2, eps):
+    new = ([], [], [])
+    for w, m, v, g, lr_t, wd in zip(ws, ms, vs, gs, lr_ts, wds):
+        g = g * rs.astype(w.dtype) + wd * w
+        m = b1.astype(w.dtype) * m + (1 - b1).astype(w.dtype) * g
+        v = b2.astype(w.dtype) * v + (1 - b2).astype(w.dtype) * \
+            jnp.square(g)
+        new[0].append(w - lr_t * m / (jnp.sqrt(v) + eps.astype(w.dtype)))
+        new[1].append(m)
+        new[2].append(v)
+    return new
+
+
+_fused_adam_jit = jax.jit(_fused_adam)
+
+
+def _fused_sgd_mom(ws, moms, gs, lrs, wds, rs, mm):
+    new_w, new_m = [], []
+    for w, m, g, lr, wd in zip(ws, moms, gs, lrs, wds):
+        g = g * rs.astype(w.dtype) + wd * w
+        m = mm.astype(w.dtype) * m - lr * g
+        new_w.append(w + m)
+        new_m.append(m)
+    return new_w, new_m
+
+
+def _fused_sgd_plain(ws, gs, lrs, wds, rs):
+    return [w - lr * (g * rs.astype(w.dtype) + wd * w)
+            for w, g, lr, wd in zip(ws, gs, lrs, wds)]
+
+
+_fused_sgd_mom_jit = jax.jit(_fused_sgd_mom)
+_fused_sgd_plain_jit = jax.jit(_fused_sgd_plain)
+
+
+def _fused_sgd_step(opt, indices, weights, grads, states):
+    """One XLA program updating every parameter (the reference's
+    multi_sgd_update multi-tensor op) — removes the per-param dispatch
+    overhead that dominated the gluon train loop."""
+    if opt.multi_precision or opt.clip_gradient is not None:
+        return False
+    for i in indices:
+        opt._update_count(i)
+    ws = [w._data for w in weights]
+    gs = [g._data for g in grads]
+    lrs = [jnp.asarray(opt._get_lr(i), w.dtype)
+           for i, w in zip(indices, ws)]
+    wds = [jnp.asarray(opt._get_wd(i), w.dtype)
+           for i, w in zip(indices, ws)]
+    rs = jnp.asarray(opt.rescale_grad, jnp.float32)
+    if opt.momentum == 0.0:
+        new_ws = _fused_sgd_plain_jit(ws, gs, lrs, wds, rs)
+        for w, nw in zip(weights, new_ws):
+            w._set_data(nw)
+        return True
+    moms = [s._data for s in states]
+    new_ws, new_ms = _fused_sgd_mom_jit(
+        ws, moms, gs, lrs, wds, rs,
+        jnp.asarray(opt.momentum, jnp.float32))
+    for w, nw, s, nm in zip(weights, new_ws, states, new_ms):
+        w._set_data(nw)
+        s._set_data(nm)
+    return True
+
 
 @register
 class NAG(Optimizer):
@@ -313,9 +381,44 @@ class Adam(Optimizer):
         state[1]._set_data(new_v)
 
 
+    def fused_step(self, indices, weights, grads, states):
+        if self.multi_precision or self.clip_gradient is not None:
+            return False
+        for i in indices:
+            self._update_count(i)
+        ws = [w._data for w in weights]
+        gs = [g._data for g in grads]
+        ms = [s[0]._data for s in states]
+        vs = [s[1]._data for s in states]
+        lr_ts, wds = [], []
+        for i, w in zip(indices, ws):
+            t = self._index_update_count[i]
+            lr_t = self._get_lr(i) * math.sqrt(1. - self.beta2 ** t) / \
+                (1. - self.beta1 ** t)
+            lr_ts.append(jnp.asarray(lr_t, w.dtype))
+            wds.append(jnp.asarray(self._get_wd(i), w.dtype))
+        new_ws, new_ms, new_vs = _fused_adam_jit(
+            ws, ms, vs, gs, lr_ts, wds,
+            jnp.asarray(self.rescale_grad, jnp.float32),
+            jnp.asarray(self.beta1, jnp.float32),
+            jnp.asarray(self.beta2, jnp.float32),
+            jnp.asarray(self.epsilon, jnp.float32))
+        for w, nw in zip(weights, new_ws):
+            w._set_data(nw)
+        for s, nm, nv in zip(states, new_ms, new_vs):
+            s[0]._set_data(nm)
+            s[1]._set_data(nv)
+        return True
+
+
 @register
 class AdamW(Adam):
     """Adam with decoupled weight decay (reference contrib adamw_update)."""
+
+    def fused_step(self, indices, weights, grads, states):
+        # the fused Adam kernel folds wd into the gradient (coupled);
+        # AdamW's decay is decoupled — keep the exact per-param path
+        return False
 
     def update(self, index, weight, grad, state):
         self._update_count(index)

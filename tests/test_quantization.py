@@ -56,7 +56,8 @@ def test_quantize_model(calib_mode):
     qsym, qargs, _ = q.quantize_model(
         net, args, {}, calib_mode=calib_mode, calib_data=calib,
         ctx=mx.cpu())
-    assert qargs["fc1_weight"].dtype == onp.int8
+    assert qargs["fc1_weight_quantized"].dtype == onp.int8
+    assert "fc1_weight" not in qargs          # fp32 copy pruned
     ops = {n.op for n in qsym._topo()}
     assert "_contrib_quantized_fully_connected" in ops
 
@@ -77,6 +78,7 @@ def test_quantize_model_excluded():
     assert "_contrib_quantized_fully_connected" in ops
     assert "FullyConnected" in ops               # fc2 stays fp32
     assert qargs["fc2_weight"].dtype == onp.float32
+    assert qargs["fc1_weight_quantized"].dtype == onp.int8
 
 
 def test_quantize_net_gluon(tmp_path):
@@ -118,3 +120,58 @@ def test_kvstore_with_compression():
     out = mx.nd.zeros((4,))
     kv.pull("w", out=out)
     onp.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5, 0.0])
+
+
+def test_quantize_model_shared_weight_stays_fp32_for_excluded():
+    # a weight consumed by both a quantized and an excluded layer must
+    # keep its fp32 values for the excluded consumer
+    data = sym.var("data")
+    w = sym.var("shared_weight")
+    a = sym.FullyConnected(data, w, num_hidden=8, no_bias=True, name="fcq")
+    b = sym.FullyConnected(data, w, num_hidden=8, no_bias=True, name="fcx")
+    out = a + b
+    rng = onp.random.default_rng(3)
+    args = {"shared_weight": mx.nd.array(
+        rng.standard_normal((8, 4)).astype(onp.float32))}
+    qsym, qargs, _ = q.quantize_model(out, args, {},
+                                      excluded_sym_names=("fcx",))
+    assert qargs["shared_weight"].dtype == onp.float32
+    assert qargs["shared_weight_quantized"].dtype == onp.int8
+    x = mx.nd.array(rng.standard_normal((2, 4)).astype(onp.float32))
+    ref = x.asnumpy() @ args["shared_weight"].asnumpy().T * 2
+    ex = qsym.bind(mx.cpu(), {**qargs, "data": x}, grad_req="null")
+    outv = ex.forward()[0].asnumpy()
+    rel = onp.abs(outv - ref).mean() / (onp.abs(ref).mean() + 1e-6)
+    assert rel < 0.05, rel
+
+
+def test_adamw_trainer_matches_per_param():
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu import autograd
+    rng = onp.random.default_rng(4)
+    w0 = rng.standard_normal((3, 5)).astype(onp.float32)
+
+    def one_step(use_trainer):
+        net = nn.Dense(3, in_units=5, use_bias=False)
+        net.initialize()
+        net.weight.set_data(mx.nd.array(w0))
+        x = mx.nd.ones((2, 5))
+        if use_trainer:
+            tr = gluon.Trainer(net.collect_params(), "adamw",
+                               {"learning_rate": 0.1, "wd": 0.1})
+            with autograd.record():
+                loss = net(x).sum()
+            loss.backward()
+            tr.step(1)
+        else:
+            opt = mx.optimizer.create("adamw", learning_rate=0.1, wd=0.1)
+            upd = mx.optimizer.get_updater(opt)
+            with autograd.record():
+                loss = net(x).sum()
+            loss.backward()
+            upd(0, net.weight.grad(), net.weight.data())
+        return net.weight.data().asnumpy()
+
+    onp.testing.assert_allclose(one_step(True), one_step(False),
+                                rtol=1e-6, atol=1e-7)
