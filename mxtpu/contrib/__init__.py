@@ -1,6 +1,7 @@
 """mx.contrib (reference ``python/mxnet/contrib/``): control flow, amp,
 quantization entry points."""
 from ..ndarray.contrib import foreach, while_loop, cond
+from ..ndarray.contrib_ops import *   # noqa: F401,F403
 
 __all__ = ["foreach", "while_loop", "cond", "amp"]
 

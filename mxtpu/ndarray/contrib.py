@@ -177,3 +177,4 @@ def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
 
 # re-export the registered ops (one implementation, two namespaces)
 from .ops import isinf, isnan, isfinite  # noqa: E402,F401
+from .contrib_ops import *  # noqa: E402,F401,F403

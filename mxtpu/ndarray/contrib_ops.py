@@ -1,0 +1,458 @@
+"""Detection / misc contrib ops (reference ``src/operator/contrib/``
+[path cite — unverified]: bounding boxes, NMS, multibox anchors,
+ROIAlign, adaptive pooling, boolean mask).
+
+TPU-first notes: everything is static-shape (XLA requirement) — NMS
+returns the fixed-size score-sorted array with suppressed entries
+marked -1 (exactly the reference's ``box_nms`` contract), and
+boolean_mask (inherently dynamic) is an eager-only op documented as
+such.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+from ..base import MXNetError
+from .ndarray import NDArray, apply_op
+from .ops import register_op
+
+__all__ = ["box_iou", "box_nms", "bipartite_matching", "MultiBoxPrior",
+           "MultiBoxTarget", "MultiBoxDetection", "ROIAlign", "ROIPooling",
+           "AdaptiveAvgPooling2D", "boolean_mask", "allclose",
+           "arange_like", "index_copy"]
+
+
+def _corner_iou(a, b):
+    """IoU between (..., N, 4) and (..., M, 4) corner boxes."""
+    ax1, ay1, ax2, ay2 = [a[..., i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("box_iou", aliases=("_contrib_box_iou",))
+def box_iou(lhs, rhs, format="corner", **kwargs):
+    """Pairwise IoU (reference _contrib_box_iou); 'corner' (x1,y1,x2,y2)
+    or 'center' (cx,cy,w,h)."""
+    def _f(a, b):
+        if format == "center":
+            def c2c(t):
+                cx, cy, w, h = [t[..., i] for i in range(4)]
+                return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                                  cy + h / 2], axis=-1)
+            a, b = c2c(a), c2c(b)
+        return _corner_iou(a, b)
+    return apply_op(_f, [lhs, rhs], "box_iou")
+
+
+@register_op("box_nms", aliases=("_contrib_box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner", **kwargs):
+    """Non-maximum suppression (reference _contrib_box_nms): rows are
+    [id, score, x1, y1, x2, y2, ...]; output is score-sorted with
+    suppressed/invalid rows' score set to -1. Static shapes: a fixed
+    O(N²) mask computed with lax.fori_loop — XLA-friendly."""
+    def _f(x):
+        batched = x.ndim == 3
+        if not batched:
+            x = x[None]
+        B, N, K = x.shape
+        scores = x[..., score_index]
+        boxes = lax.dynamic_slice_in_dim(x, coord_start, 4, axis=2)
+        if in_format == "center":
+            cx, cy, w, h = [boxes[..., i] for i in range(4)]
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                               cy + h / 2], axis=-1)
+        ids = x[..., id_index] if id_index >= 0 else None
+        order = jnp.argsort(-scores, axis=1)
+        xs = jnp.take_along_axis(x, order[..., None], axis=1)
+        scores_s = jnp.take_along_axis(scores, order, axis=1)
+        boxes_s = jnp.take_along_axis(boxes, order[..., None], axis=1)
+        iou = _corner_iou(boxes_s, boxes_s)           # (B, N, N)
+        valid = scores_s > valid_thresh
+        if topk > 0:
+            valid = valid & (jnp.arange(N)[None, :] < topk)
+        if ids is not None and not force_suppress:
+            ids_s = jnp.take_along_axis(ids, order, axis=1)
+            same_cls = ids_s[..., :, None] == ids_s[..., None, :]
+            iou = jnp.where(same_cls, iou, 0.0)
+
+        def body(i, keep):
+            # suppress j > i overlapping a kept i
+            row = iou[:, i, :]
+            sup = (row > overlap_thresh) & \
+                (jnp.arange(N)[None, :] > i) & keep[:, i][:, None]
+            return keep & ~sup
+        keep = lax.fori_loop(0, N, body, valid)
+        new_scores = jnp.where(keep, scores_s, -1.0)
+        out = xs.at[..., score_index].set(new_scores)
+        if out_format != in_format:
+            bsel = lax.dynamic_slice_in_dim(out, coord_start, 4, axis=2)
+            if out_format == "corner":      # center → corner
+                cx, cy, w, h = [bsel[..., i] for i in range(4)]
+                conv = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                                  cy + h / 2], axis=-1)
+            else:                           # corner → center
+                x1, y1, x2, y2 = [bsel[..., i] for i in range(4)]
+                conv = jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2,
+                                  x2 - x1, y2 - y1], axis=-1)
+            out = lax.dynamic_update_slice_in_dim(out, conv, coord_start,
+                                                  axis=2)
+        return out if batched else out[0]
+    return apply_op(_f, [data], "box_nms")
+
+
+@register_op("bipartite_matching", aliases=("_contrib_bipartite_matching",))
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1,
+                       **kwargs):
+    """Greedy bipartite matching over a score matrix (reference
+    _contrib_bipartite_matching): returns (row→col match or -1,
+    col→row match or -1)."""
+    def _f(x):
+        batched = x.ndim == 3
+        if not batched:
+            x = x[None]
+        B, N, M = x.shape
+        sgn = 1.0 if is_ascend else -1.0
+        big = jnp.float32(1e30)
+
+        def body(_, carry):
+            rmatch, cmatch, mat = carry
+            flat = (sgn * mat).reshape(B, -1)
+            idx = jnp.argmin(flat, axis=1)
+            val = jnp.take_along_axis(mat.reshape(B, -1), idx[:, None],
+                                      axis=1)[:, 0]
+            r, c = idx // M, idx % M
+            ok = (val > threshold) if not is_ascend else (val < threshold)
+            ok = ok & (jnp.take_along_axis(rmatch, r[:, None], 1)[:, 0] < 0)
+            rmatch = jnp.where(
+                ok[:, None] & (jnp.arange(N)[None] == r[:, None]),
+                c[:, None].astype(rmatch.dtype), rmatch)
+            cmatch = jnp.where(
+                ok[:, None] & (jnp.arange(M)[None] == c[:, None]),
+                r[:, None].astype(cmatch.dtype), cmatch)
+            # invalidate matched row+col (sgn*mat must become +big so
+            # argmin never revisits them)
+            mat = jnp.where((jnp.arange(N)[None, :, None] == r[:, None, None]) |
+                            (jnp.arange(M)[None, None, :] == c[:, None, None]),
+                            sgn * big, mat)
+            return rmatch, cmatch, mat
+
+        rmatch = jnp.full((B, N), -1.0)
+        cmatch = jnp.full((B, M), -1.0)
+        iters = min(N, M) if topk <= 0 else min(topk, min(N, M))
+        rmatch, cmatch, _ = lax.fori_loop(0, iters, body,
+                                          (rmatch, cmatch, x))
+        if not batched:
+            return rmatch[0], cmatch[0]
+        return rmatch, cmatch
+    return apply_op(_f, [data], "bipartite_matching", n_out=2)
+
+
+@register_op("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",))
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kwargs):
+    """SSD anchor generation (reference multibox_prior.cc): for an
+    (B, C, H, W) feature map emits (1, H*W*(S+R-1), 4) corner anchors."""
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+
+    def _f(x):
+        H, W = x.shape[2], x.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / H
+        step_x = steps[1] if steps[1] > 0 else 1.0 / W
+        cy = (jnp.arange(H) + offsets[0]) * step_y
+        cx = (jnp.arange(W) + offsets[1]) * step_x
+        cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"),
+                        axis=-1).reshape(-1, 2)          # (H*W, [y, x])
+        # reference order: (s_i, r_0) for all sizes, then (s_0, r_j) j>0
+        whs = [(s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0]))
+               for s in sizes] + \
+              [(sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r))
+               for r in ratios[1:]]
+        anchors = []
+        for w, h in whs:
+            a = jnp.concatenate([
+                cyx[:, 1:2] - w / 2, cyx[:, 0:1] - h / 2,
+                cyx[:, 1:2] + w / 2, cyx[:, 0:1] + h / 2], axis=1)
+            anchors.append(a)
+        out = jnp.stack(anchors, axis=1).reshape(-1, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out[None]
+    return apply_op(_f, [data], "MultiBoxPrior")
+
+
+@register_op("ROIAlign", aliases=("_contrib_ROIAlign",))
+def ROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=2, position_sensitive=False, **kwargs):
+    """ROI Align (reference roi_align.cc): bilinear sampling on a
+    (B, C, H, W) feature map for rois (R, 5) = [batch_idx, x1, y1, x2,
+    y2]."""
+    ph, pw = pooled_size
+    sr = max(int(sample_ratio), 1)
+
+    def _f(feat, r):
+        B, C, H, W = feat.shape
+        bidx = r[:, 0].astype(jnp.int32)
+        x1, y1, x2, y2 = [r[:, i] * spatial_scale for i in range(1, 5)]
+        rw = jnp.maximum(x2 - x1, 1e-6)
+        rh = jnp.maximum(y2 - y1, 1e-6)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: (ph*sr, pw*sr) points per roi
+        gy = (jnp.arange(ph * sr) + 0.5) / sr      # in bin units
+        gx = (jnp.arange(pw * sr) + 0.5) / sr
+        ys = y1[:, None] + gy[None, :] * bin_h[:, None]   # (R, ph*sr)
+        xs = x1[:, None] + gx[None, :] * bin_w[:, None]
+
+        def bilinear(fmap, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            # fmap (C, H, W); yy/xx (ph*sr, pw*sr)
+            f00 = fmap[:, y0[:, None], x0[None, :]]
+            f01 = fmap[:, y0[:, None], x1_[None, :]]
+            f10 = fmap[:, y1_[:, None], x0[None, :]]
+            f11 = fmap[:, y1_[:, None], x1_[None, :]]
+            return (f00 * (1 - wy[:, None]) * (1 - wx[None, :]) +
+                    f01 * (1 - wy[:, None]) * wx[None, :] +
+                    f10 * wy[:, None] * (1 - wx[None, :]) +
+                    f11 * wy[:, None] * wx[None, :])
+
+        def per_roi(b, yy, xx):
+            fmap = feat[b]
+            samples = bilinear(fmap, yy, xx)       # (C, ph*sr, pw*sr)
+            return samples.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(bidx, ys, xs)
+    return apply_op(_f, [data, rois], "ROIAlign")
+
+
+@register_op("ROIPooling")
+def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0, **kwargs):
+    """Max ROI pooling (reference roi_pooling.cc) approximated by dense
+    sampling + max (static shapes)."""
+    ph, pw = pooled_size
+
+    def _f(feat, r):
+        B, C, H, W = feat.shape
+        bidx = r[:, 0].astype(jnp.int32)
+        x1, y1, x2, y2 = [jnp.round(r[:, i] * spatial_scale)
+                          for i in range(1, 5)]
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        sr = 4
+        gy = (jnp.arange(ph * sr) + 0.5) / (ph * sr)
+        gx = (jnp.arange(pw * sr) + 0.5) / (pw * sr)
+        ys = y1[:, None] + gy[None, :] * rh[:, None]
+        xs = x1[:, None] + gx[None, :] * rw[:, None]
+
+        def per_roi(b, yy, xx):
+            fmap = feat[b]
+            yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            samples = fmap[:, yi[:, None], xi[None, :]]
+            return samples.reshape(C, ph, sr, pw, sr).max(axis=(2, 4))
+        return jax.vmap(per_roi)(bidx, ys, xs)
+    return apply_op(_f, [data, rois], "ROIPooling")
+
+
+@register_op("AdaptiveAvgPooling2D",
+             aliases=("_contrib_AdaptiveAvgPooling2D",))
+def AdaptiveAvgPooling2D(data, output_size=1, **kwargs):
+    """Adaptive average pooling (reference adaptive_avg_pooling.cc)."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+
+    def _f(x):
+        B, C, H, W = x.shape
+        # split H into oh (possibly uneven) bins like the reference
+        ys = [(H * i) // oh for i in range(oh + 1)]
+        xs_ = [(W * i) // ow for i in range(ow + 1)]
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                cols.append(x[:, :, ys[i]:ys[i + 1],
+                              xs_[j]:xs_[j + 1]].mean(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+    return apply_op(_f, [data], "AdaptiveAvgPooling2D")
+
+
+def boolean_mask(data, index, axis: int = 0):
+    """Select rows where index != 0 (reference _contrib_boolean_mask).
+    Output shape is data-dependent → eager-only (documented; inside
+    jit use `where`/SequenceMask instead)."""
+    if isinstance(index, NDArray):
+        mask = onp.asarray(index._data) != 0
+    else:
+        mask = onp.asarray(index) != 0
+    sel = onp.nonzero(mask)[0]
+    return apply_op(lambda x: jnp.take(x, jnp.asarray(sel), axis=axis),
+                    [data], "boolean_mask")
+
+
+@register_op("allclose", aliases=("_contrib_allclose",))
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False, **kwargs):
+    return apply_op(
+        lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan).astype(jnp.float32),
+        [a, b], "allclose")
+
+
+@register_op("arange_like", aliases=("_contrib_arange_like",))
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kwargs):
+    def _f(x):
+        n = x.size if axis is None else x.shape[axis]
+        # reference semantics: output length stays n; with repeat each
+        # value appears `repeat` times within it
+        count = -(-n // repeat)
+        out = jnp.arange(count, dtype=jnp.float32) * step + start
+        if repeat > 1:
+            out = jnp.repeat(out, repeat)[:n]
+        if axis is None:
+            out = out.reshape(x.shape)
+        return out
+    return apply_op(_f, [data], "arange_like")
+
+
+@register_op("index_copy", aliases=("_contrib_index_copy",))
+def index_copy(old, index, new_tensor, **kwargs):
+    def _f(o, idx, n):
+        return o.at[idx.astype(jnp.int32)].set(n)
+    return apply_op(_f, [old, index, new_tensor], "index_copy")
+
+
+# -- MultiBox target/detection (SSD training/decoding) ----------------------
+@register_op("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",))
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1, negative_mining_ratio=-1,
+                   negative_mining_thresh=0.5,
+                   variances=(0.1, 0.1, 0.2, 0.2), **kwargs):
+    """SSD training targets (reference multibox_target.cc): per-anchor
+    box regression targets + mask + class targets from ground truth
+    ``label`` (B, M, 5) = [cls, x1, y1, x2, y2] (cls = -1 padding)."""
+    v = variances
+
+    def _f(anc, lab, _pred):
+        A = anc.shape[1] if anc.ndim == 3 else anc.shape[0]
+        anc2 = anc.reshape(-1, 4)
+        B, M, _ = lab.shape
+        gt_boxes = lab[..., 1:5]
+        gt_cls = lab[..., 0]
+        valid_gt = gt_cls >= 0
+        iou = _corner_iou(anc2[None], gt_boxes)      # (B, A, M)
+        iou = jnp.where(valid_gt[:, None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=2)            # (B, A)
+        best_iou = jnp.max(iou, axis=2)
+        pos = best_iou >= overlap_threshold
+        # each gt's best anchor is positive too
+        best_anchor = jnp.argmax(iou, axis=1)        # (B, M)
+        # duplicate-safe: padded gts all argmax to anchor 0 — additive
+        # scatter can't erase a real gt's flag the way .set(False) would
+        force = jax.vmap(
+            lambda ba, vg: jnp.zeros((A,), jnp.int32)
+            .at[ba].add(vg.astype(jnp.int32)))(best_anchor, valid_gt) > 0
+        pos = pos | force
+        matched = jnp.take_along_axis(
+            gt_boxes, best_gt[..., None], axis=1)
+        # encode: (gt_center - anc_center)/anc_wh/var, log(gt_wh/anc_wh)/var
+        aw = anc2[:, 2] - anc2[:, 0]
+        ah = anc2[:, 3] - anc2[:, 1]
+        acx = (anc2[:, 0] + anc2[:, 2]) / 2
+        acy = (anc2[:, 1] + anc2[:, 3]) / 2
+        gw = jnp.maximum(matched[..., 2] - matched[..., 0], 1e-8)
+        gh = jnp.maximum(matched[..., 3] - matched[..., 1], 1e-8)
+        gcx = (matched[..., 0] + matched[..., 2]) / 2
+        gcy = (matched[..., 1] + matched[..., 3]) / 2
+        tx = (gcx - acx[None]) / (aw[None] * v[0])
+        ty = (gcy - acy[None]) / (ah[None] * v[1])
+        tw = jnp.log(gw / aw[None]) / v[2]
+        th = jnp.log(gh / ah[None]) / v[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(pos[..., None], loc_t, 0.0).reshape(B, -1)
+        loc_mask = jnp.repeat(pos.astype(jnp.float32), 4, axis=1) \
+            .reshape(B, A, 4).reshape(B, -1)
+        matched_cls = jnp.take_along_axis(gt_cls, best_gt, axis=1)
+        cls_t = jnp.where(pos, matched_cls + 1, 0.0)   # 0 = background
+        if negative_mining_ratio > 0:
+            # hard-negative mining (reference): keep the
+            # ratio*num_pos hardest negatives as background targets,
+            # mark the rest ignore_label. Hardness = max foreground
+            # probability predicted for a negative anchor.
+            probs = jax.nn.softmax(_pred, axis=1)
+            hardness = jnp.max(probs[:, 1:, :], axis=1)     # (B, A)
+            neg = (~pos) & (best_iou < negative_mining_thresh)
+            hardness = jnp.where(neg, hardness, -1.0)
+            order = jnp.argsort(-hardness, axis=1)
+            rank = jnp.argsort(order, axis=1)
+            num_pos = jnp.sum(pos, axis=1, keepdims=True)
+            keep_neg = neg & (rank < negative_mining_ratio * num_pos)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0,
+                                        float(ignore_label)))
+        return loc_t, loc_mask, cls_t
+    return apply_op(_f, [anchor, label, cls_pred], "MultiBoxTarget",
+                    n_out=3)
+
+
+@register_op("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection",))
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      nms_threshold=0.5, force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kwargs):
+    """SSD decode + NMS (reference multibox_detection.cc):
+    cls_prob (B, num_cls+1, A), loc_pred (B, A*4), anchors (1, A, 4) →
+    (B, A, 6) rows [cls_id, score, x1, y1, x2, y2], suppressed = -1."""
+    v = variances
+
+    def _f(cp, lp, anc):
+        B, _, A = cp.shape
+        anc2 = anc.reshape(-1, 4)
+        aw = anc2[:, 2] - anc2[:, 0]
+        ah = anc2[:, 3] - anc2[:, 1]
+        acx = (anc2[:, 0] + anc2[:, 2]) / 2
+        acy = (anc2[:, 1] + anc2[:, 3]) / 2
+        loc = lp.reshape(B, A, 4)
+        cx = loc[..., 0] * v[0] * aw + acx
+        cy = loc[..., 1] * v[1] * ah + acy
+        w = jnp.exp(loc[..., 2] * v[2]) * aw
+        h = jnp.exp(loc[..., 3] * v[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                           cy + h / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores = cp[:, 1:, :]                      # skip background
+        cls_id = jnp.argmax(scores, axis=1).astype(jnp.float32)
+        score = jnp.max(scores, axis=1)
+        keep = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[..., None],
+             jnp.where(keep, score, -1.0)[..., None], boxes], axis=-1)
+        return rows
+    decoded = apply_op(_f, [cls_prob, loc_pred, anchor],
+                       "MultiBoxDecode")
+    return box_nms(decoded, overlap_thresh=nms_threshold, valid_thresh=0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)

@@ -1,0 +1,202 @@
+"""Contrib op tests (reference tests/python/unittest/test_contrib_*.py
+patterns; numpy references computed inline)."""
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu.ndarray import contrib_ops as c
+
+
+def _iou_np(a, b):
+    ix1 = onp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = onp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = onp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = onp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = onp.clip(ix2 - ix1, 0, None) * onp.clip(iy2 - iy1, 0, None)
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = aa[:, None] + ab[None, :] - inter
+    return onp.where(union > 0, inter / union, 0)
+
+
+def test_box_iou():
+    rng = onp.random.default_rng(0)
+    a = rng.uniform(0, 0.5, (5, 4)).astype(onp.float32)
+    a[:, 2:] += a[:, :2]
+    b = rng.uniform(0, 0.5, (7, 4)).astype(onp.float32)
+    b[:, 2:] += b[:, :2]
+    out = c.box_iou(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    onp.testing.assert_allclose(out, _iou_np(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms():
+    # three boxes: 2nd overlaps 1st heavily, 3rd is disjoint
+    data = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.05, 0.05, 1.0, 1.0],
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0]], onp.float32)
+    out = c.box_nms(mx.nd.array(data), overlap_thresh=0.5).asnumpy()
+    scores = out[:, 1]
+    assert scores[0] == onp.float32(0.9)
+    assert scores[1] == -1.0              # suppressed
+    assert scores[2] == onp.float32(0.7)
+    # per-class (id_index=0): different ids don't suppress
+    data[1, 0] = 1
+    out2 = c.box_nms(mx.nd.array(data), overlap_thresh=0.5,
+                     id_index=0).asnumpy()
+    assert (out2[:, 1] > 0).sum() == 3
+
+
+def test_multibox_prior():
+    x = mx.nd.zeros((1, 3, 2, 2))
+    anchors = c.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2)).asnumpy()
+    # 2x2 positions x (2 sizes + 1 extra ratio) = 12 anchors
+    assert anchors.shape == (1, 12, 4)
+    # first anchor centered at (0.25, 0.25) with size 0.5
+    onp.testing.assert_allclose(anchors[0, 0],
+                                [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+
+
+def test_roialign_shapes_and_values():
+    # constant feature map: pooled output must equal the constant
+    feat = onp.full((1, 2, 8, 8), 3.0, onp.float32)
+    rois = onp.array([[0, 1.0, 1.0, 6.0, 6.0]], onp.float32)
+    out = c.ROIAlign(mx.nd.array(feat), mx.nd.array(rois),
+                     pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    onp.testing.assert_allclose(out, 3.0, rtol=1e-6)
+    # linear ramp in x: left bins < right bins
+    ramp = onp.tile(onp.arange(8, dtype=onp.float32), (8, 1))[None, None]
+    out2 = c.ROIAlign(mx.nd.array(ramp), mx.nd.array(rois),
+                      pooled_size=(1, 2)).asnumpy()
+    assert out2[0, 0, 0, 0] < out2[0, 0, 0, 1]
+
+
+def test_roipooling():
+    feat = onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4)
+    rois = onp.array([[0, 0, 0, 3, 3]], onp.float32)
+    out = c.ROIPooling(mx.nd.array(feat), mx.nd.array(rois),
+                       pooled_size=(2, 2)).asnumpy()
+    onp.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_adaptive_avg_pooling():
+    x = onp.arange(36, dtype=onp.float32).reshape(1, 1, 6, 6)
+    out = c.AdaptiveAvgPooling2D(mx.nd.array(x), output_size=2).asnumpy()
+    ref = x.reshape(1, 1, 2, 3, 2, 3).mean(axis=(3, 5))
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+    g = c.AdaptiveAvgPooling2D(mx.nd.array(x), output_size=1).asnumpy()
+    onp.testing.assert_allclose(g.ravel(), [x.mean()], rtol=1e-6)
+
+
+def test_boolean_mask_and_allclose_and_arange_like():
+    x = mx.nd.array(onp.arange(10, dtype=onp.float32).reshape(5, 2))
+    m = mx.nd.array(onp.array([1, 0, 1, 0, 1], onp.float32))
+    out = c.boolean_mask(x, m)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                x.asnumpy()[[0, 2, 4]])
+    assert float(c.allclose(x, x).asscalar()) == 1.0
+    assert float(c.allclose(x, x + 1).asscalar()) == 0.0
+    ar = c.arange_like(mx.nd.zeros((3, 4)), axis=1)
+    onp.testing.assert_allclose(ar.asnumpy(), [0, 1, 2, 3])
+
+
+def test_index_copy():
+    old = mx.nd.zeros((5, 2))
+    new = mx.nd.ones((2, 2)) * 7
+    idx = mx.nd.array(onp.array([1.0, 3.0]))
+    out = c.index_copy(old, idx, new).asnumpy()
+    assert out[1].tolist() == [7, 7] and out[3].tolist() == [7, 7]
+    assert out[0].tolist() == [0, 0]
+
+
+def test_bipartite_matching():
+    score = onp.array([[0.9, 0.1], [0.8, 0.85]], onp.float32)
+    r, col = c.bipartite_matching(mx.nd.array(score), threshold=0.05)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.85
+    assert r.asnumpy().tolist() == [0, 1]
+    assert col.asnumpy().tolist() == [0, 1]
+
+
+def test_multibox_target_and_detection():
+    anchors = onp.array([[[0.0, 0.0, 0.5, 0.5],
+                          [0.5, 0.5, 1.0, 1.0]]], onp.float32)
+    label = onp.array([[[0, 0.05, 0.05, 0.45, 0.45]]], onp.float32)
+    cls_pred = onp.zeros((1, 2, 2), onp.float32)
+    loc_t, loc_mask, cls_t = c.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred))
+    assert cls_t.asnumpy()[0, 0] == 1.0        # matched → class 0 + 1
+    assert cls_t.asnumpy()[0, 1] == 0.0        # background
+    assert loc_mask.asnumpy()[0, :4].sum() == 4
+    # decode round trip: zero offsets + perfect class prob → anchor box
+    cp = onp.zeros((1, 2, 2), onp.float32)
+    cp[0, 1, 0] = 0.9                          # class 0 at anchor 0
+    lp = onp.zeros((1, 8), onp.float32)
+    det = c.MultiBoxDetection(mx.nd.array(cp), mx.nd.array(lp),
+                              mx.nd.array(anchors)).asnumpy()
+    best = det[0, 0]
+    assert best[0] == 0.0 and best[1] == onp.float32(0.9)
+    onp.testing.assert_allclose(best[2:], anchors[0, 0], atol=1e-6)
+
+
+def test_gluon_contrib_layers():
+    from mxtpu.gluon import contrib as gcontrib
+    import mxtpu.gluon as gluon
+    net = gcontrib.nn.HybridConcurrent(axis=1)
+    from mxtpu.gluon import nn
+    net.add(nn.Dense(2), nn.Dense(3))
+    net.initialize()
+    out = net(mx.nd.ones((4, 5)))
+    assert out.shape == (4, 5)
+    ps = gcontrib.nn.PixelShuffle2D(2)
+    x = mx.nd.array(onp.arange(16, dtype=onp.float32).reshape(1, 4, 2, 2))
+    y = ps(x)
+    assert y.shape == (1, 1, 4, 4)
+    sbn = gcontrib.nn.SyncBatchNorm(in_channels=3, num_devices=8)
+    sbn.initialize()
+    assert sbn(mx.nd.ones((2, 3, 4, 4))).shape == (2, 3, 4, 4)
+
+
+def test_multibox_target_padding_and_mining():
+    # padded gt rows must not erase a real gt's forced-positive anchor
+    anchors = onp.array([[[0, 0, 0.4, 0.4], [0.6, 0.6, 1, 1]]], onp.float32)
+    label = onp.array([[[0, 0, 0, 0.9, 0.2],
+                        [-1, 0, 0, 0, 0]]], onp.float32)   # padding
+    cls_pred = onp.zeros((1, 2, 2), onp.float32)
+    _, _, cls_t = c.MultiBoxTarget(mx.nd.array(anchors),
+                                   mx.nd.array(label),
+                                   mx.nd.array(cls_pred))
+    assert cls_t.asnumpy()[0, 0] == 1.0      # low-IoU gt still matched
+    # negative mining: with ratio 1 and one positive, one negative kept
+    # as background, others → ignore_label
+    anchors4 = onp.array([[[0, 0, 0.4, 0.4], [0.6, 0.6, 1, 1],
+                           [0, 0.6, 0.4, 1], [0.6, 0, 1, 0.4]]],
+                         onp.float32)
+    label1 = onp.array([[[0, 0.0, 0.0, 0.41, 0.41]]], onp.float32)
+    pred = onp.zeros((1, 3, 4), onp.float32)
+    pred[0, 1, 1] = 5.0                       # anchor 1 is the hard one
+    _, _, cls_t2 = c.MultiBoxTarget(
+        mx.nd.array(anchors4), mx.nd.array(label1), mx.nd.array(pred),
+        negative_mining_ratio=1, ignore_label=-1)
+    vals = cls_t2.asnumpy()[0]
+    assert vals[0] == 1.0                     # positive
+    assert vals[1] == 0.0                     # hard negative kept
+    assert vals[2] == -1.0 and vals[3] == -1.0  # ignored
+
+
+def test_box_nms_center_format():
+    data = onp.array([[0, 0.9, 0.5, 0.5, 0.4, 0.4],
+                      [0, 0.8, 0.52, 0.52, 0.4, 0.4]], onp.float32)
+    out = c.box_nms(mx.nd.array(data), overlap_thresh=0.5,
+                    in_format="center").asnumpy()
+    assert out[1, 1] == -1.0                  # overlapping: suppressed
+    # out_format conversion round-trips the coordinates
+    out2 = c.box_nms(mx.nd.array(data), overlap_thresh=0.5,
+                     in_format="center", out_format="corner").asnumpy()
+    onp.testing.assert_allclose(out2[0, 2:], [0.3, 0.3, 0.7, 0.7],
+                                atol=1e-6)
+
+
+def test_arange_like_repeat():
+    out = c.arange_like(mx.nd.zeros((4,)), repeat=2).asnumpy()
+    onp.testing.assert_allclose(out, [0, 0, 1, 1])
