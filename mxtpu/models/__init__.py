@@ -8,8 +8,10 @@ parity and, in addition, provides functional cores here: pure
 compose directly with ``mxtpu.parallel`` (sharding rules, jitted train
 step, remat, scan-over-layers) — the idiomatic shape for pjit/XLA.
 """
+from . import bert
 from . import llama
 from . import resnet
+from .bert import BertConfig
 from .llama import LlamaConfig
 from .resnet import ResNetConfig
 

@@ -1,0 +1,245 @@
+"""BERT — masked-LM pretraining family (BASELINE config 3; the
+reference ecosystem ran BERT through GluonNLP over
+``src/operator/contrib/transformer.cc`` interleaved-attention ops
+[path cite — unverified]).
+
+TPU-first functional design, mirroring mxtpu/models/llama.py:
+- bf16 activations / f32 params, scan-over-layers (small HLO),
+  optional remat,
+- post-LN transformer encoder (original BERT), learned positions,
+- MLM + NSP heads (MLM head reuses tied word embeddings, like the
+  original),
+- sharding rules: tp on attention/FFN projections, dp/fsdp on the
+  batch — composes with parallel.step.make_train_step.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dense_attention
+from ..parallel.sharding import P, ShardingRules
+
+__all__ = ["BertConfig", "CONFIGS", "init_params", "forward", "loss_fn",
+           "sharding_rules"]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS: Dict[str, BertConfig] = {
+    "tiny": BertConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                       hidden_dim=128, max_seq_len=64, remat=False),
+    "bert_base": BertConfig(),
+    "bert_large": BertConfig(dim=1024, n_layers=24, n_heads=16,
+                             hidden_dim=4096),
+}
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def _init_layer(key, cfg: BertConfig):
+    d, h = cfg.dim, cfg.hidden_dim
+    ks = jax.random.split(key, 8)
+    dt = cfg.param_dtype
+
+    def init(k, shape):
+        # BERT's canonical truncated-normal(0.02) init, flat across
+        # layers (unlike llama's fan-in scaling)
+        return jax.random.normal(k, shape, dt) * 0.02
+
+    return {
+        "qkv_w": init(ks[0], (d, 3 * d)),
+        "qkv_b": jnp.zeros((3 * d,), dt),
+        "attn_out_w": init(ks[1], (d, d)),
+        "attn_out_b": jnp.zeros((d,), dt),
+        "ln1_g": jnp.ones((d,), dt), "ln1_b": jnp.zeros((d,), dt),
+        "ffn_in_w": init(ks[2], (d, h)),
+        "ffn_in_b": jnp.zeros((h,), dt),
+        "ffn_out_w": init(ks[3], (h, d)),
+        "ffn_out_b": jnp.zeros((d,), dt),
+        "ln2_g": jnp.ones((d,), dt), "ln2_b": jnp.zeros((d,), dt),
+    }
+
+
+def init_params(cfg: BertConfig, rng: Optional[jax.Array] = None):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 8)
+    d = cfg.dim
+    dt = cfg.param_dtype
+    layers = [_init_layer(k, cfg)
+              for k in jax.random.split(ks[0], cfg.n_layers)]
+    if cfg.scan_layers:
+        layer_params = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    else:
+        layer_params = layers
+    return {
+        "tok_emb": jax.random.normal(ks[1], (cfg.vocab_size, d), dt) * 0.02,
+        "pos_emb": jax.random.normal(ks[2], (cfg.max_seq_len, d), dt) * 0.02,
+        "type_emb": jax.random.normal(ks[3], (cfg.type_vocab_size, d),
+                                      dt) * 0.02,
+        "emb_ln_g": jnp.ones((d,), dt), "emb_ln_b": jnp.zeros((d,), dt),
+        "layers": layer_params,
+        "pool_w": jax.random.normal(ks[4], (d, d), dt) * 0.02,
+        "pool_b": jnp.zeros((d,), dt),
+        "mlm_w": jax.random.normal(ks[5], (d, d), dt) * 0.02,
+        "mlm_b": jnp.zeros((d,), dt),
+        "mlm_ln_g": jnp.ones((d,), dt), "mlm_ln_b": jnp.zeros((d,), dt),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), dt),
+        "nsp_w": jax.random.normal(ks[6], (d, 2), dt) * 0.02,
+        "nsp_b": jnp.zeros((2,), dt),
+    }
+
+
+def sharding_rules(cfg: Optional[BertConfig] = None) -> ShardingRules:
+    """tp over attention heads / FFN inner dim, fsdp over the first
+    axis of big tables (same recipe as llama.sharding_rules).
+    scan_layers (the default) stacks per-layer params with a leading
+    layer axis, so the specs carry a leading None."""
+    scan = cfg.scan_layers if cfg is not None else True
+    return ShardingRules([
+        (r".*tok_emb", P("fsdp", "tp")),
+        (r".*pos_emb", P(None, "tp")),
+        (r".*qkv_w", P(None, "fsdp", "tp") if scan else P("fsdp", "tp")),
+        (r".*attn_out_w", P(None, "tp", "fsdp") if scan
+         else P("tp", "fsdp")),
+        (r".*ffn_in_w", P(None, "fsdp", "tp") if scan
+         else P("fsdp", "tp")),
+        (r".*ffn_out_w", P(None, "tp", "fsdp") if scan
+         else P("tp", "fsdp")),
+        (r".*mlm_w", P("fsdp", "tp")),
+        (r".*", P()),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _layer_norm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * g.astype(jnp.float32) +
+            b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _encoder_layer(cfg: BertConfig, x, mask, lp):
+    B, S, D = x.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+    qkv = x @ lp["qkv_w"].astype(dt) + lp["qkv_b"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    # shared attention kernel (same masked-softmax semantics as the
+    # blockwise/ring variants used by llama)
+    ctx = dense_attention(q, k, v,
+                          mask=(mask[:, None, None, :] > 0)).astype(dt)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+    ctx = ctx @ lp["attn_out_w"].astype(dt) + lp["attn_out_b"].astype(dt)
+    x = _layer_norm(x + ctx, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+    h = jax.nn.gelu(x @ lp["ffn_in_w"].astype(dt) +
+                    lp["ffn_in_b"].astype(dt), approximate=True)
+    h = h @ lp["ffn_out_w"].astype(dt) + lp["ffn_out_b"].astype(dt)
+    return _layer_norm(x + h, lp["ln2_g"], lp["ln2_b"], cfg.norm_eps)
+
+
+def forward(cfg: BertConfig, params, tokens, token_types=None, mask=None):
+    """tokens (B, S) int32 → (sequence_output (B,S,D) f32,
+    pooled_output (B,D) f32)."""
+    B, S = tokens.shape
+    if S > cfg.max_seq_len:
+        raise ValueError(
+            f"sequence length {S} exceeds max_seq_len {cfg.max_seq_len}")
+    dt = cfg.dtype
+    if mask is None:
+        mask = jnp.ones((B, S), jnp.float32)
+    if token_types is None:
+        token_types = jnp.zeros((B, S), jnp.int32)
+    x = params["tok_emb"][tokens].astype(dt) + \
+        params["pos_emb"][None, :S].astype(dt) + \
+        params["type_emb"][token_types].astype(dt)
+    x = _layer_norm(x, params["emb_ln_g"], params["emb_ln_b"],
+                    cfg.norm_eps)
+
+    def one_layer(x, lp):
+        return _encoder_layer(cfg, x, mask, lp)
+
+    if cfg.remat:
+        one_layer = jax.checkpoint(one_layer)
+    if cfg.scan_layers:
+        def body(x, lp):
+            return one_layer(x, lp), None
+        x, _ = jax.lax.scan(body, x, params["layers"])
+    else:
+        for lp in params["layers"]:
+            x = one_layer(x, lp)
+    seq_out = x.astype(jnp.float32)
+    pooled = jnp.tanh(seq_out[:, 0] @ params["pool_w"].astype(jnp.float32)
+                      + params["pool_b"].astype(jnp.float32))
+    return seq_out, pooled
+
+
+def mlm_logits(cfg: BertConfig, params, seq_out):
+    """Masked-LM head: transform + tied-embedding decode."""
+    h = jax.nn.gelu(seq_out @ params["mlm_w"].astype(jnp.float32) +
+                    params["mlm_b"].astype(jnp.float32), approximate=True)
+    h = _layer_norm(h, params["mlm_ln_g"], params["mlm_ln_b"],
+                    cfg.norm_eps)
+    return h @ params["tok_emb"].astype(jnp.float32).T + \
+        params["mlm_bias"].astype(jnp.float32)
+
+
+def loss_fn(cfg: BertConfig):
+    """Pretraining loss over batches {'tokens', 'mask', 'mlm_positions',
+    'mlm_labels', 'mlm_weights'[, 'token_types', 'nsp_labels']}:
+    MLM cross-entropy (+ NSP when labels present) — the reference-era
+    BERT objective."""
+
+    def loss(params, batch):
+        seq_out, pooled = forward(cfg, params, batch["tokens"],
+                                  batch.get("token_types"),
+                                  batch["mask"])
+        pos = batch["mlm_positions"]                 # (B, P) int32
+        gathered = jnp.take_along_axis(
+            seq_out, pos[..., None].astype(jnp.int32), axis=1)
+        logits = mlm_logits(cfg, params, gathered)   # (B, P, V)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        lab = batch["mlm_labels"].astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+        w = batch["mlm_weights"].astype(jnp.float32)
+        mlm_loss = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+        total = mlm_loss
+        if "nsp_labels" in batch:
+            nsp = pooled @ params["nsp_w"].astype(jnp.float32) + \
+                params["nsp_b"].astype(jnp.float32)
+            nsp_logp = jax.nn.log_softmax(nsp, axis=-1)
+            nsp_lab = batch["nsp_labels"].astype(jnp.int32)
+            total = total - jnp.mean(
+                jnp.take_along_axis(nsp_logp, nsp_lab[:, None],
+                                    axis=-1))
+        return total
+    return loss
