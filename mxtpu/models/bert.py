@@ -61,13 +61,13 @@ CONFIGS: Dict[str, BertConfig] = {
 # ---------------------------------------------------------------------------
 def _init_layer(key, cfg: BertConfig):
     d, h = cfg.dim, cfg.hidden_dim
-    ks = jax.random.split(key, 8)
+    ks = jax.random.split(key, 4)
     dt = cfg.param_dtype
 
     def init(k, shape):
-        # BERT's canonical truncated-normal(0.02) init, flat across
-        # layers (unlike llama's fan-in scaling)
-        return jax.random.normal(k, shape, dt) * 0.02
+        # BERT's canonical truncated-normal(std 0.02, clipped ±2σ)
+        # init, flat across layers (unlike llama's fan-in scaling)
+        return jax.random.truncated_normal(k, -2.0, 2.0, shape, dt) * 0.02
 
     return {
         "qkv_w": init(ks[0], (d, 3 * d)),
@@ -85,7 +85,7 @@ def _init_layer(key, cfg: BertConfig):
 
 def init_params(cfg: BertConfig, rng: Optional[jax.Array] = None):
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    ks = jax.random.split(rng, 8)
+    ks = jax.random.split(rng, 7)
     d = cfg.dim
     dt = cfg.param_dtype
     layers = [_init_layer(k, cfg)
@@ -94,20 +94,22 @@ def init_params(cfg: BertConfig, rng: Optional[jax.Array] = None):
         layer_params = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
     else:
         layer_params = layers
+    def _tn(k, shape):
+        return jax.random.truncated_normal(k, -2.0, 2.0, shape, dt) * 0.02
+
     return {
-        "tok_emb": jax.random.normal(ks[1], (cfg.vocab_size, d), dt) * 0.02,
-        "pos_emb": jax.random.normal(ks[2], (cfg.max_seq_len, d), dt) * 0.02,
-        "type_emb": jax.random.normal(ks[3], (cfg.type_vocab_size, d),
-                                      dt) * 0.02,
+        "tok_emb": _tn(ks[1], (cfg.vocab_size, d)),
+        "pos_emb": _tn(ks[2], (cfg.max_seq_len, d)),
+        "type_emb": _tn(ks[3], (cfg.type_vocab_size, d)),
         "emb_ln_g": jnp.ones((d,), dt), "emb_ln_b": jnp.zeros((d,), dt),
         "layers": layer_params,
-        "pool_w": jax.random.normal(ks[4], (d, d), dt) * 0.02,
+        "pool_w": _tn(ks[4], (d, d)),
         "pool_b": jnp.zeros((d,), dt),
-        "mlm_w": jax.random.normal(ks[5], (d, d), dt) * 0.02,
+        "mlm_w": _tn(ks[5], (d, d)),
         "mlm_b": jnp.zeros((d,), dt),
         "mlm_ln_g": jnp.ones((d,), dt), "mlm_ln_b": jnp.zeros((d,), dt),
         "mlm_bias": jnp.zeros((cfg.vocab_size,), dt),
-        "nsp_w": jax.random.normal(ks[6], (d, 2), dt) * 0.02,
+        "nsp_w": _tn(ks[6], (d, 2)),
         "nsp_b": jnp.zeros((2,), dt),
     }
 
