@@ -44,7 +44,7 @@ def __getattr__(name):
             "test_utils", "util", "runtime", "recordio", "np", "npx",
             "sym", "model", "engine", "parallel", "models", "ops",
             "utils", "amp", "contrib", "rnn", "serde", "module", "mod",
-            "monitor", "operator", "checkpoint", "native"}
+            "monitor", "operator", "checkpoint", "native", "rtc"}
     if name in lazy:
         mod = {"sym": "mxtpu.symbol", "np": "mxtpu.numpy",
                "npx": "mxtpu.numpy_extension",

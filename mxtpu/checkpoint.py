@@ -74,7 +74,8 @@ def save_state(path: str, state: Any) -> None:
     """One-shot synchronous pytree save (orbax StandardCheckpointer)."""
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.abspath(path), state)
+    # force: refreshing a fixed path ('latest') is the common pattern
+    ckptr.save(os.path.abspath(path), state, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
 

@@ -93,15 +93,16 @@ def rand_ndarray(shape, ctx=None, dtype="float32") -> NDArray:
     return array(_np.random.randn(*shape), ctx=ctx, dtype=dtype)
 
 
-def check_numeric_gradient(f: Callable, inputs: Sequence[NDArray],
+def check_numeric_gradient(f: Callable, inputs: Sequence,
                            eps: float = 1e-3, rtol: float = 1e-2,
                            atol: float = 1e-3) -> None:
     """Central-difference check of the tape backward of scalar-output ``f``.
 
     Reference check_numeric_gradient perturbs each input element; here f
-    maps NDArrays → scalar NDArray loss.
-    """
+    maps NDArrays → scalar NDArray loss. Accepts NDArrays or numpy
+    arrays."""
     from . import autograd
+    inputs = [x if isinstance(x, NDArray) else array(x) for x in inputs]
     inputs = [x.astype("float64") if x.dtype.kind == "f" else x
               for x in inputs]
     for x in inputs:
@@ -140,11 +141,16 @@ def check_numeric_gradient(f: Callable, inputs: Sequence[NDArray],
                                     err_msg=f"gradient of input {xi}")
 
 
-def check_consistency(f: Callable, inputs_np: Sequence[_np.ndarray],
+def check_consistency(f: Callable, inputs_np=None,
                       ctx_list: Optional[Sequence[_ctx.Context]] = None,
-                      rtol=None, atol=None) -> None:
+                      rtol=None, atol=None, inputs=None) -> None:
     """Run ``f`` on each context and cross-check outputs — the rebuild's
-    cpu-vs-tpu analogue of the reference's cpu-vs-gpu check_consistency."""
+    cpu-vs-tpu analogue of the reference's cpu-vs-gpu check_consistency.
+    ``inputs`` is a keyword alias for ``inputs_np``."""
+    if inputs_np is None:
+        inputs_np = inputs
+    if inputs_np is None:
+        raise ValueError("check_consistency needs input numpy arrays")
     if ctx_list is None:
         ctx_list = [_ctx.cpu(0)]
         if _ctx.num_tpus() > 0:
@@ -187,3 +193,4 @@ def with_seed(seed: Optional[int] = None):
                 raise
         return wrapper
     return deco
+

@@ -133,11 +133,26 @@ def test_fault_injection_resume(tmp_path):
     # interrupted run: SIGKILL after a few steps
     proc = subprocess.Popen([sys.executable, str(worker), ckdir, "12", out],
                             env=env, stdout=subprocess.PIPE, text=True)
+    # reader thread: readline() blocks, so the deadline must live
+    # outside it or a stalled worker hangs the whole test run
+    import queue as _queue
+    import threading
+    q = _queue.Queue()
+
+    def _pump():
+        for line in proc.stdout:
+            q.put(line)
+        q.put(None)
+
+    threading.Thread(target=_pump, daemon=True).start()
     seen = 0
     deadline = time.time() + 240
     while seen < 5:
-        line = proc.stdout.readline()
-        if not line or time.time() > deadline:
+        try:
+            line = q.get(timeout=max(0.1, deadline - time.time()))
+        except _queue.Empty:
+            line = None
+        if line is None:
             proc.kill()
             raise AssertionError(
                 f"worker exited/stalled before 5 steps (saw {seen})")
