@@ -44,12 +44,14 @@ def __getattr__(name):
             "test_utils", "util", "runtime", "recordio", "np", "npx",
             "sym", "model", "engine", "parallel", "models", "ops",
             "utils", "amp", "contrib", "rnn", "serde", "module", "mod",
-            "monitor", "operator", "checkpoint", "native", "rtc"}
+            "monitor", "operator", "checkpoint", "native", "rtc",
+            "visualization", "viz"}
     if name in lazy:
         mod = {"sym": "mxtpu.symbol", "np": "mxtpu.numpy",
                "npx": "mxtpu.numpy_extension",
                "rnn": "mxtpu.gluon.rnn",
-               "mod": "mxtpu.module"}.get(name, f"mxtpu.{name}")
+               "mod": "mxtpu.module",
+               "viz": "mxtpu.visualization"}.get(name, f"mxtpu.{name}")
         try:
             m = importlib.import_module(mod)
         except ModuleNotFoundError as e:

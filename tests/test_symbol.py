@@ -228,3 +228,31 @@ def test_dropout_symbol_train_vs_test():
     out_train = ex.forward(is_train=True, x=v)[0].asnumpy()
     assert (out_train == 0).sum() > 10          # some dropped
     assert np.allclose(out_train[out_train > 0], 2.0)  # scaled
+
+
+def test_visualization(capsys):
+    data = sym.var("data")
+    net = sym.Convolution(data, num_filter=4, kernel=(3, 3), name="c1")
+    net = sym.Activation(net, act_type="relu", name="a1")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=10, name="fc")
+    total = mx.viz.print_summary(net, shape={"data": (1, 3, 8, 8)})
+    out = capsys.readouterr().out
+    assert "c1 (Convolution)" in out and "Total params" in out
+    # conv: 4*3*3*3 + 4; fc: 10*(4*6*6) + 10
+    assert total == (4 * 3 * 3 * 3 + 4) + (10 * 4 * 6 * 6 + 10)
+    dot = mx.viz.plot_network(net, shape={"data": (1, 3, 8, 8)})
+    assert dot.startswith("digraph") and '"c1"' in dot and "->" in dot
+
+
+def test_visualization_nonstandard_input_names():
+    x = sym.var("x")
+    net = sym.FullyConnected(x, num_hidden=10, name="fc")
+    total = mx.viz.print_summary(net, shape={"x": (1, 20)})
+    assert total == 10 * 20 + 10          # input var not counted
+    dot = mx.viz.plot_network(net)
+    assert '"x"' in dot and '"x" -> "fc"' in dot
+    # absolute positions form accepted
+    mx.viz.print_summary(net, shape={"x": (1, 20)},
+                         positions=[50, 80, 95, 120])
+    dot2 = mx.viz.plot_network(net, node_attrs={"shape": "oval"})
+    assert "shape=oval" in dot2
