@@ -49,16 +49,18 @@ def main():
     labels = jnp.asarray(rng.integers(0, cfg.num_classes, batch), jnp.int32)
     data = {"image": images, "label": labels}
 
-    # warmup: compile + 2 steady steps
+    # warmup: compile + 2 steady steps (sync via host readback — the
+    # axon plugin's block_until_ready can return before the queue
+    # drains, which would fake the timing)
     for _ in range(3):
         state, loss = train_step(state, data)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
 
-    steps = 10
+    steps = 30
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = train_step(state, data)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 
     img_s = batch * steps / dt

@@ -69,8 +69,8 @@ def main():
             batch = synth_batch(rng, cfg, args.batch_size, args.seq_len,
                                 n_pred)
         state, loss = step(state, batch)
-    jax.block_until_ready(loss)
-    dt = time.time() - t0
+    float(jax.device_get(loss))    # honest sync (axon block_until_ready
+    dt = time.time() - t0         # can return early)
     print(f"final loss {float(loss):.4f}")
     print(f"{args.batch_size * args.steps / dt:.1f} samples/s "
           f"({dt / args.steps * 1e3:.1f} ms/step)")

@@ -6,7 +6,9 @@ cite]). The reference pairs each array with an engine variable and pushes
 every op to the ThreadedEngine; here the asynchrony comes for free from
 XLA/PJRT async dispatch (a ``jax.Array`` is a future), so:
 
-- ``WaitToRead``  → ``jax.block_until_ready``
+- ``WaitToRead``  → host readback sync (``_sync``; the axon TPU
+  plugin's ``block_until_ready`` can return before the queue drains,
+  so a 1-element device_get is the reliable fence)
 - engine var + version → a Python-level ``_version`` counter; "mutation"
   rebinds ``_data`` to a new jax.Array (buffer donation inside jitted
   update steps recovers in-place performance where it matters)
@@ -32,6 +34,16 @@ __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
            "concat", "stack", "waitall", "from_jax", "save", "load"]
 
 _NAIVE = env_str("MXNET_ENGINE_TYPE", "ThreadedEngine") == "NaiveEngine"
+
+
+def _sync(data) -> None:
+    """Reliable completion fence for one jax array: block_until_ready
+    PLUS a single-element readback (the axon plugin's block_until_ready
+    alone can return early). The scalar slice avoids materializing a
+    full-size copy for the readback."""
+    data.block_until_ready()
+    if data.size:
+        jax.device_get(data[(0,) * data.ndim])
 
 # installed by mxtpu.profiler when profiling: fn(op_name, dispatch_secs)
 _profile_hook = None
@@ -79,7 +91,7 @@ def apply_op(raw_fn: Callable, arrays: Sequence["NDArray"], name: str = "",
         if node is not None:
             res._ag = (node, 0)
         if _NAIVE:
-            res._data.block_until_ready()
+            _sync(res._data)
         return res
     results = []
     for i, o in enumerate(out):
@@ -88,7 +100,8 @@ def apply_op(raw_fn: Callable, arrays: Sequence["NDArray"], name: str = "",
             r._ag = (node, i)
         results.append(r)
     if _NAIVE:
-        jax.block_until_ready([r._data for r in results])
+        for r in results:
+            _sync(r._data)
     return tuple(results)
 
 
@@ -142,7 +155,7 @@ class NDArray:
 
     # -- sync / host interop ------------------------------------------------
     def wait_to_read(self) -> None:
-        self._data.block_until_ready()
+        _sync(self._data)
 
     wait_to_write = wait_to_read
 
@@ -534,11 +547,14 @@ def stack(*arrays, axis: int = 0) -> NDArray:
 def waitall() -> None:
     """Block until all queued computation completes (Engine::WaitForAll).
 
-    PJRT executes FIFO per device, so blocking on a fresh no-op enqueued on
-    each device awaits everything queued before it, on every device.
+    PJRT executes FIFO per device, so syncing on a fresh no-op enqueued
+    on each device awaits everything queued before it. The sync is a
+    device_get (host readback), not block_until_ready: the axon TPU
+    plugin's block_until_ready can return before the queue drains
+    (verified empirically), while a host readback cannot.
     """
-    for dev in jax.devices():
-        jax.device_put(0, dev).block_until_ready()
+    for dev in jax.local_devices():
+        jax.device_get(jax.device_put(0, dev))
 
 
 # ---------------------------------------------------------------------------

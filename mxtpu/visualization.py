@@ -96,12 +96,26 @@ def plot_network(symbol, title: str = "plot", shape: Optional[Dict] = None,
         import warnings
         warnings.warn(f"save_format={save_format!r} needs a graphviz "
                       "runtime (not installed); emitting DOT source")
+
+    def q(s):   # DOT-quote (names/values may hold spaces or quotes)
+        return '"' + str(s).replace('"', '\\"') + '"'
+
     base_attrs = {"shape": "box", "style": "filled", "fixedsize": "false"}
     base_attrs.update(node_attrs or {})
-    attr_str = ", ".join(f"{k}={v}" for k, v in base_attrs.items())
-    lines = [f'digraph "{title}" {{',
+    attr_str = ", ".join(f"{k}={q(v)}" for k, v in base_attrs.items())
+    lines = [f'digraph {q(title)} {{',
              f"  node [{attr_str}];"]
     nodes = symbol._topo()
+    # optional edge shape labels (reference behavior with shape=...)
+    edge_shapes = {}
+    if shape is not None:
+        structs = symbol._infer_structs(**shape)
+        if structs is not None:
+            entry_structs, var_structs = structs
+            edge_shapes = {k: tuple(v.shape)
+                           for k, v in entry_structs.items()}
+            edge_shapes.update({("var", n): tuple(v.shape)
+                                for n, v in var_structs.items()})
 
     def shown(var_node):
         return not hide_weights or not _is_param_var(var_node.name)
@@ -111,18 +125,23 @@ def plot_network(symbol, title: str = "plot", shape: Optional[Dict] = None,
             if not shown(node):
                 continue
             lines.append(
-                f'  "{node.name}" [label="{node.name}", '
+                f'  {q(node.name)} [label={q(node.name)}, '
                 f'fillcolor=white];')
         else:
             color = _OP_COLORS.get(node.op, "azure")
             label = f"{node.name}\\n({node.op})"
             lines.append(
-                f'  "{node.name}" [label="{label}", fillcolor={color}];')
+                f'  {q(node.name)} [label={q(label)}, '
+                f'fillcolor={q(color)}];')
     for node in nodes:
         if node.is_var():
             continue
-        for p, _ in node.inputs:
+        for p, idx in node.inputs:
             if not p.is_var() or shown(p):
-                lines.append(f'  "{p.name}" -> "{node.name}";')
+                eshape = edge_shapes.get(
+                    ("var", p.name) if p.is_var() else (id(p), idx))
+                lbl = f" [label={q(eshape)}]" if eshape else ""
+                lines.append(
+                    f'  {q(p.name)} -> {q(node.name)}{lbl};')
     lines.append("}")
     return "\n".join(lines)

@@ -242,6 +242,7 @@ def test_visualization(capsys):
     assert total == (4 * 3 * 3 * 3 + 4) + (10 * 4 * 6 * 6 + 10)
     dot = mx.viz.plot_network(net, shape={"data": (1, 3, 8, 8)})
     assert dot.startswith("digraph") and '"c1"' in dot and "->" in dot
+    assert "(1, 4, 6, 6)" in dot          # edge shape labels
 
 
 def test_visualization_nonstandard_input_names():
@@ -254,5 +255,7 @@ def test_visualization_nonstandard_input_names():
     # absolute positions form accepted
     mx.viz.print_summary(net, shape={"x": (1, 20)},
                          positions=[50, 80, 95, 120])
-    dot2 = mx.viz.plot_network(net, node_attrs={"shape": "oval"})
-    assert "shape=oval" in dot2
+    dot2 = mx.viz.plot_network(net, node_attrs={"shape": "oval",
+                                                "fontname": "Courier New"})
+    assert 'shape="oval"' in dot2
+    assert 'fontname="Courier New"' in dot2
