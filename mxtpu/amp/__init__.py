@@ -80,10 +80,13 @@ def convert_model(sym, arg_params, aux_params,
 
 
 def init_trainer(trainer):
-    """Attach a dynamic LossScaler to a Trainer (reference
-    ``amp.init_trainer``); no-op scale for bfloat16."""
-    scaler = LossScaler(
-        init_scale=1.0 if _target() == "bfloat16" else 2 ** 16)
+    """Attach a LossScaler to a Trainer (reference ``amp.init_trainer``).
+    For bfloat16 the scaler is static (scale 1.0, ``dynamic=False``):
+    bf16 shares f32's exponent range, so Trainer.step skips the per-step
+    isfinite reduction + host sync entirely."""
+    bf16 = _target() == "bfloat16"
+    scaler = LossScaler(init_scale=1.0 if bf16 else 2 ** 16,
+                        dynamic=not bf16)
     trainer._amp_loss_scaler = scaler
     trainer._amp_original_scale = trainer._scale
     return trainer
@@ -113,13 +116,22 @@ def unscale(trainer):
         raise MXNetError("call amp.init_trainer(trainer) first")
     if getattr(trainer, "_amp_unscaled", False):
         return trainer._amp_last_finite    # idempotent: already unscaled
+    if not scaler.dynamic:                 # bf16: fixed scale 1.0
+        trainer._amp_unscaled = True
+        trainer._amp_last_finite = True
+        return True
     params = [p for p in trainer._params
               if p.grad_req != "null" and p._data is not None]
     grads = [p.grad() for p in params]
     # grads carry the scale active during backward — capture it before
-    # has_overflow() adjusts the scaler for the NEXT step
+    # update_scale() adjusts the scaler for the NEXT step
     applied_scale = scaler.loss_scale
-    finite = scaler.has_overflow(grads) is False
+    # the unscale/skip decision must be GLOBAL: if any rank overflowed,
+    # every rank leaves its grads scaled and skips the update
+    if not trainer._kv_initialized:
+        trainer._init_kvstore()
+    finite = trainer._all_workers_finite(scaler.is_finite(grads))
+    scaler.update_scale(not finite)
     if finite and applied_scale != 1.0:
         for g in grads:
             g._set_data(g._data / applied_scale)

@@ -12,23 +12,30 @@ __all__ = ["LossScaler"]
 class LossScaler:
     def __init__(self, init_scale: float = 2 ** 16,
                  scale_factor: float = 2.0, scale_window: int = 2000,
-                 min_scale: float = 1.0):
+                 min_scale: float = 1.0, dynamic: bool = True):
         self.loss_scale = float(init_scale)
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._min_scale = min_scale
         self._unskipped = 0
+        # bfloat16 shares f32's exponent range: scale stays fixed and the
+        # per-step isfinite reduction + host sync is skipped entirely
+        self.dynamic = dynamic
 
-    def has_overflow(self, grads) -> bool:
-        """Check grads for inf/nan and update the scale (reference
-        LossScaler.has_overflow + update_scale). One fused device
+    def is_finite(self, grads) -> bool:
+        """Pure finiteness check — no scale update. One fused device
         reduction + one host sync regardless of parameter count."""
-        overflow = False
-        if grads:
-            datas = [g._data if hasattr(g, "_data") else g for g in grads]
-            finite = jnp.all(jnp.stack(
-                [jnp.isfinite(d).all() for d in datas]))
-            overflow = not bool(finite)
+        if not grads:
+            return True
+        datas = [g._data if hasattr(g, "_data") else g for g in grads]
+        return bool(jnp.all(jnp.stack(
+            [jnp.isfinite(d).all() for d in datas])))
+
+    def update_scale(self, overflow: bool) -> None:
+        """Apply the dynamic-scaling policy for one step's (globally
+        agreed) overflow decision."""
+        if not self.dynamic:
+            return
         if overflow:
             self.loss_scale = max(self._min_scale,
                                   self.loss_scale / self._scale_factor)
@@ -38,4 +45,12 @@ class LossScaler:
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+
+    def has_overflow(self, grads) -> bool:
+        """Check grads for inf/nan and update the scale (reference
+        LossScaler.has_overflow + update_scale). Single-process
+        convenience — distributed callers must combine ``is_finite``
+        across workers before ``update_scale`` so ranks agree."""
+        overflow = not self.is_finite(grads)
+        self.update_scale(overflow)
         return overflow

@@ -67,22 +67,41 @@ class Trainer:
                 self._compression_params)
         self._kv_initialized = True
 
+    def _all_workers_finite(self, finite: bool) -> bool:
+        """Combine a local overflow verdict across workers so every rank
+        makes the same skip decision (the reference checks overflow
+        globally after reduction — a rank-local check would let replicas
+        diverge permanently: one rank skips while others fold its inf/nan
+        grads into their update)."""
+        kv = self._kvstore
+        if kv is None or kv.num_workers == 1 or \
+                not hasattr(kv, "_allreduce"):
+            return finite
+        from .. import ndarray as _nd
+        overflow_count = kv._allreduce(
+            _nd.array([0.0 if finite else 1.0]))
+        return float(overflow_count.asnumpy()[0]) == 0.0
+
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size, reduce, and update parameters.
 
         With AMP attached (amp.init_trainer), overflowed float16 grads
-        SKIP the update and shrink the loss scale — the reference's
+        SKIP the update — on ALL workers, via a global finite-flag
+        reduction — and shrink the loss scale, the reference's
         dynamic-loss-scaling step behavior."""
         if not self._kv_initialized:
             self._init_kvstore()
         scaler = getattr(self, "_amp_loss_scaler", None)
-        if scaler is not None:
+        if scaler is not None and scaler.dynamic:
             if getattr(self, "_amp_unscaled", False):
+                # amp.unscale() already combined the verdict globally
                 overflow = not getattr(self, "_amp_last_finite", True)
             else:
                 grads = [p.grad() for p in self._params
                          if p.grad_req != "null" and p._data is not None]
-                overflow = scaler.has_overflow(grads)
+                overflow = not self._all_workers_finite(
+                    scaler.is_finite(grads))
+                scaler.update_scale(overflow)
             if overflow:
                 # drop this update; scale_loss picks up the reduced
                 # scale on the next backward

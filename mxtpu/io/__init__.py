@@ -181,21 +181,23 @@ class NDArrayIter(DataIter):
                 self.cursor + self.batch_size <= self.num_data
         return self.cursor < self.num_data
 
-    def _take(self, arrays):
+    def _batch_indices(self):
+        """Index array for the current batch — the single source for
+        both the data served (_take) and the reported order (getindex)."""
         lo = self.cursor
         hi = self.cursor + self.batch_size
-        out = []
-        for _, v in arrays:
-            if lo < 0:   # roll_over: previous epoch's tail + new head
-                sel = onp.concatenate([self._rolled, self.idx[:hi]]) \
-                    if hi > 0 else self._rolled
-            elif hi <= self.num_data:
-                sel = self.idx[lo:hi]
-            else:        # pad: wrap around from the head
-                sel = onp.concatenate(
-                    [self.idx[lo:], self.idx[:hi - self.num_data]])
-            out.append(nd.array(v[sel], dtype=v.dtype))
-        return out
+        if lo < 0:       # roll_over: previous epoch's tail + new head
+            return onp.concatenate([self._rolled, self.idx[:hi]]) \
+                if hi > 0 else self._rolled
+        if hi <= self.num_data:
+            return self.idx[lo:hi]
+        # pad: wrap around from the head
+        return onp.concatenate(
+            [self.idx[lo:], self.idx[:hi - self.num_data]])
+
+    def _take(self, arrays):
+        sel = self._batch_indices()
+        return [nd.array(v[sel], dtype=v.dtype) for _, v in arrays]
 
     def getdata(self):
         return self._take(self.data)
@@ -210,13 +212,7 @@ class NDArrayIter(DataIter):
         return 0
 
     def getindex(self):
-        lo, hi = self.cursor, self.cursor + self.batch_size
-        if lo < 0:
-            return onp.concatenate([self.idx[lo:], self.idx[:max(hi, 0)]])
-        if hi > self.num_data:
-            return onp.concatenate(
-                [self.idx[lo:], self.idx[:hi - self.num_data]])
-        return self.idx[lo:hi]
+        return self._batch_indices()
 
 
 class CSVIter(DataIter):

@@ -50,12 +50,17 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             vals = v if isinstance(v, (list, tuple)) else [v]
+            comp = getattr(self, "_compression", None)
+            if comp is not None:
+                # quantize each device contribution BEFORE reduction,
+                # with a per-contribution error-feedback residual —
+                # kvstore_dist semantics (servers see ternary values,
+                # not a quantized sum)
+                vals = [comp.decompress(k, comp.compress((k, i), vi))
+                        for i, vi in enumerate(vals)]
             agg = vals[0]
             for extra in vals[1:]:
                 agg = agg + extra
-            comp = getattr(self, "_compression", None)
-            if comp is not None:
-                agg = comp.decompress(k, comp.compress(k, agg))
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
             else:

@@ -64,15 +64,18 @@ class DistKVStore(KVStore):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             vals = v if isinstance(v, (list, tuple)) else [v]
-            agg = vals[0]
-            for extra in vals[1:]:
-                agg = agg + extra
             comp = getattr(self, "_compression", None)
             if comp is not None:
                 # compress on the wire (reference kvstore_dist +
-                # gradient_compression.cc): quantize locally with error
-                # feedback, reduce the ternary values
-                agg = comp.decompress(k, comp.compress(k, agg))
+                # gradient_compression.cc): quantize EACH local
+                # contribution with its own error-feedback residual,
+                # reduce the ternary values — same numerics as the base
+                # store's multi-value push
+                vals = [comp.decompress(k, comp.compress((k, i), vi))
+                        for i, vi in enumerate(vals)]
+            agg = vals[0]
+            for extra in vals[1:]:
+                agg = agg + extra
             agg = self._allreduce(agg)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])

@@ -97,17 +97,45 @@ class MXRecordIO:
                     "forked process would truncate the file")
             self.reset()
 
-    def write(self, buf: bytes):
-        assert self.writable
-        self._check_pid(allow_reset=False)
+    def _write_chunk(self, buf: bytes, cflag: int):
         length = len(buf)
-        if length >= (1 << 29):
-            raise MXNetError("record too large for RecordIO (>512MB)")
-        self.record.write(struct.pack("<II", _KMAGIC, length))
+        self.record.write(struct.pack("<II", _KMAGIC,
+                                      (cflag << 29) | length))
         self.record.write(buf)
         pad = (-length) % 4
         if pad:
             self.record.write(b"\x00" * pad)
+
+    def write(self, buf: bytes):
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        if len(buf) >= (1 << 29):      # reject BEFORE any bytes hit disk
+            raise MXNetError("record too large for RecordIO (>512MB)")
+        # dmlc escaping: a payload containing kMagic at a 4-byte-aligned
+        # offset (payloads start file-aligned, so in-payload alignment ==
+        # file alignment) would fool boundary-scanning readers
+        # (InputSplit/RecordIOSplitter). Split at those magics into
+        # multi-part chunks (cflag 1=first, 2=middle, 3=last); read()
+        # re-joins by re-inserting the magic bytes.
+        magic = struct.pack("<I", _KMAGIC)
+        parts = []
+        start = 0
+        i = buf.find(magic)
+        while i != -1:
+            if i % 4 == 0:             # only aligned hits need escaping
+                parts.append(buf[start:i])
+                start = i + 4
+                i = buf.find(magic, start)
+            else:
+                i = buf.find(magic, i + 1)
+        parts.append(buf[start:])
+        if len(parts) == 1:
+            self._write_chunk(buf, 0)
+        else:
+            last = len(parts) - 1
+            for j, p in enumerate(parts):
+                self._write_chunk(p, 1 if j == 0 else (3 if j == last
+                                                       else 2))
 
     def _read_chunk(self):
         header = self.record.read(8)

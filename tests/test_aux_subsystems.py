@@ -211,3 +211,56 @@ def test_amp_unscale_idempotent():
     g1 = net.weight.grad().asnumpy().copy()
     assert amp.unscale(tr)                 # no double division
     onp.testing.assert_allclose(net.weight.grad().asnumpy(), g1)
+
+
+def test_amp_bf16_scaler_is_static():
+    # ADVICE r1: bfloat16 needs no loss scaling — the scaler must be
+    # static (no per-step isfinite reduction / host sync, no silent
+    # update-skip on a stray inf)
+    amp.init("bfloat16")
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    scaler = tr._amp_loss_scaler
+    assert scaler.dynamic is False
+    assert scaler.loss_scale == 1.0
+    x = mx.nd.ones((2, 4))
+    with autograd.record():
+        with amp.scale_loss(net(x).sum(), tr) as scaled:
+            pass
+        scaled.backward()
+    assert amp.unscale(tr)                  # no reduction, always finite
+    w0 = net.weight.data().asnumpy()
+    tr.step(2)                              # no overflow check path
+    assert not onp.allclose(net.weight.data().asnumpy(), w0)
+    # static scale never changes even if told about overflow
+    scaler.update_scale(True)
+    assert scaler.loss_scale == 1.0
+
+
+def test_loss_scaler_split_api():
+    from mxtpu.amp.loss_scaler import LossScaler
+    s = LossScaler(init_scale=1024, scale_window=2)
+    assert s.is_finite([mx.nd.ones((2,))])
+    assert s.loss_scale == 1024             # pure check: no update
+    assert not s.is_finite([mx.nd.array([onp.inf, 1.0])])
+    assert s.loss_scale == 1024
+    s.update_scale(True)
+    assert s.loss_scale == 512
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 1024
+
+
+def test_trainer_global_overflow_single_process():
+    # single process: _all_workers_finite is the identity
+    amp.init("float16")
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    assert tr._all_workers_finite(True) is True
+    assert tr._all_workers_finite(False) is False

@@ -341,3 +341,52 @@ def test_imageiter_shuffle_without_idx_raises(tmp_path):
 
 def test_missing_attr_is_attribute_error():
     assert not hasattr(mx, "definitely_not_a_module")
+
+
+def test_recordio_write_escapes_aligned_magic(tmp_path):
+    # writer must emit the dmlc multi-part encoding when the payload
+    # contains kMagic at a 4-byte-aligned offset, so boundary-scanning
+    # readers (InputSplit/RecordIOSplitter) can't mis-split
+    path = str(tmp_path / "esc.rec")
+    magic = struct.pack("<I", 0xced7230a)
+    payloads = [
+        b"abcd" + magic + b"efgh",          # one aligned magic
+        magic + b"xy",                       # magic at offset 0
+        b"abcd" + magic + magic + b"zz",     # adjacent magics
+        b"ab" + magic + b"cd",               # UNaligned: must NOT split
+        b"plain",
+    ]
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    # the escaped file must never contain an aligned in-payload magic:
+    # every aligned magic occurrence is a real chunk header
+    with open(path, "rb") as f:
+        raw = f.read()
+    pos = 0
+    while pos + 8 <= len(raw):
+        assert raw[pos:pos + 4] == magic, f"lost sync at {pos}"
+        lrec, = struct.unpack("<I", raw[pos + 4:pos + 8])
+        length = lrec & ((1 << 29) - 1)
+        pos += 8 + length + ((-length) % 4)
+    assert pos == len(raw)
+
+
+def test_ndarrayiter_roll_over_getindex_matches_data():
+    # ADVICE r1: getindex for the rolled batch must report the indices
+    # of the data actually served (pre-shuffle tail), not idx[lo:]
+    data = onp.arange(10, dtype=onp.float32).reshape(10, 1)
+    it = mio.NDArrayIter(data, None, batch_size=4, shuffle=True,
+                         last_batch_handle="roll_over")
+    for _ in it:
+        pass
+    it.reset()
+    batch = next(it)
+    idx = it.getindex()
+    onp.testing.assert_array_equal(
+        batch.data[0].asnumpy().ravel(), data[idx].ravel())
