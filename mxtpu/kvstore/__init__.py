@@ -44,27 +44,34 @@ class KVStore:
                 raise MXNetError(f"key {k} already initialized")
             self._store[k] = v.copy() if isinstance(v, NDArray) else nd.array(v)
 
+    def _local_aggregate(self, k, v) -> NDArray:
+        """Sum one key's pushed contribution(s), quantizing each BEFORE
+        reduction with a per-contribution error-feedback residual —
+        kvstore_dist semantics (servers see ternary values, not a
+        quantized sum). Shared by local and dist push."""
+        if k not in self._store:
+            raise MXNetError(f"key {k} not initialized")
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        comp = getattr(self, "_compression", None)
+        if comp is not None:
+            vals = [comp.decompress(k, comp.compress((k, i), vi))
+                    for i, vi in enumerate(vals)]
+        agg = vals[0]
+        for extra in vals[1:]:
+            agg = agg + extra
+        return agg
+
+    def _apply(self, k, agg: NDArray) -> None:
+        """Run the updater on an aggregated value (or store it)."""
+        if self._updater is not None:
+            self._updater(k, agg, self._store[k])
+        else:
+            self._store[k] = agg.copy()
+
     def push(self, key, value, priority: int = 0) -> None:
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            if k not in self._store:
-                raise MXNetError(f"key {k} not initialized")
-            vals = v if isinstance(v, (list, tuple)) else [v]
-            comp = getattr(self, "_compression", None)
-            if comp is not None:
-                # quantize each device contribution BEFORE reduction,
-                # with a per-contribution error-feedback residual —
-                # kvstore_dist semantics (servers see ternary values,
-                # not a quantized sum)
-                vals = [comp.decompress(k, comp.compress((k, i), vi))
-                        for i, vi in enumerate(vals)]
-            agg = vals[0]
-            for extra in vals[1:]:
-                agg = agg + extra
-            if self._updater is not None:
-                self._updater(k, agg, self._store[k])
-            else:
-                self._store[k] = agg.copy()
+            self._apply(k, self._local_aggregate(k, v))
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:

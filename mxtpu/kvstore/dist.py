@@ -61,26 +61,10 @@ class DistKVStore(KVStore):
     def push(self, key, value, priority: int = 0) -> None:
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            if k not in self._store:
-                raise MXNetError(f"key {k} not initialized")
-            vals = v if isinstance(v, (list, tuple)) else [v]
-            comp = getattr(self, "_compression", None)
-            if comp is not None:
-                # compress on the wire (reference kvstore_dist +
-                # gradient_compression.cc): quantize EACH local
-                # contribution with its own error-feedback residual,
-                # reduce the ternary values — same numerics as the base
-                # store's multi-value push
-                vals = [comp.decompress(k, comp.compress((k, i), vi))
-                        for i, vi in enumerate(vals)]
-            agg = vals[0]
-            for extra in vals[1:]:
-                agg = agg + extra
-            agg = self._allreduce(agg)
-            if self._updater is not None:
-                self._updater(k, agg, self._store[k])
-            else:
-                self._store[k] = agg.copy()
+            # local quantize+sum (shared with the base store, so
+            # single-process and distributed numerics agree), then
+            # all-reduce the ternary values across workers
+            self._apply(k, self._allreduce(self._local_aggregate(k, v)))
 
     def allreduce_grads(self, params) -> None:
         """Trainer hook: SUM grads across workers in place (reference
