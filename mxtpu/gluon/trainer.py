@@ -65,6 +65,13 @@ class Trainer:
         if self._kvstore is not None and self._compression_params:
             self._kvstore.set_gradient_compression(
                 self._compression_params)
+        if self._kvstore is not None and \
+                hasattr(self._kvstore, "broadcast_params"):
+            # reference kv.init semantics: all workers start from
+            # rank 0's initial parameter values — including frozen
+            # (grad_req='null') params, which would otherwise keep
+            # divergent per-rank copies forever
+            self._kvstore.broadcast_params(self._params)
         self._kv_initialized = True
 
     def _all_workers_finite(self, finite: bool) -> bool:

@@ -100,3 +100,63 @@ def test_im2rec_exists_and_diagnose():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 0, out.stderr[-500:]
     assert "mxtpu version" in out.stdout
+
+
+@pytest.mark.slow
+def test_dist_allreduce_fast_path_matches_veneer(tmp_path):
+    """VERDICT r1 #3: Trainer's dist grad reduction must ride ONE jitted
+    collective program (no per-param host hops) and agree bitwise with
+    the KVStore veneer."""
+    worker = tmp_path / "fast_worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import mxtpu as mx
+        from mxtpu.parallel import dist
+        dist.initialize()
+        kv = mx.kv.create("dist_sync")
+        rank, W = kv.rank, kv.num_workers
+        assert W == 2, W
+
+        rng = np.random.default_rng(rank)
+        grads = [mx.nd.array(rng.standard_normal((5, 3))
+                             .astype(np.float32)),
+                 mx.nd.array(rng.standard_normal((7,))
+                             .astype(np.float32))]
+        expected = [kv._allreduce(g).asnumpy() for g in grads]
+
+        for step in range(3):   # same signature → one compile total
+            fast = kv._allreduce_tree([g._data for g in grads])
+            for f, e in zip(fast, expected):
+                assert (np.asarray(f) == e).all(), (step, f, e)
+        assert kv.num_collective_compiles == 1, \\
+            kv.num_collective_compiles
+
+        # end-to-end Gluon Trainer drive: both ranks end bit-identical
+        from mxtpu import gluon, autograd
+        from mxtpu.gluon import nn
+        net = nn.Dense(2, in_units=3)
+        net.initialize()  # deterministic seed → same init on all ranks
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {{"learning_rate": 0.1}}, kvstore=kv)
+        x = mx.nd.array(rng.standard_normal((4, 3)).astype(np.float32))
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(4)
+        w = net.weight.data().asnumpy()
+        got = kv._allreduce(mx.nd.array(w)).asnumpy()
+        assert np.allclose(got, W * w, rtol=1e-6), "ranks diverged"
+        kv.barrier()
+        print("FASTOK", rank, flush=True)
+    """))
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--env", "JAX_PLATFORMS=cpu", "--",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert out.stdout.count("FASTOK") == 2
