@@ -1191,3 +1191,8 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
     if state_outputs:
         return apply_op(_f, arrs, "RNN", n_out=n_out)
     return apply_op(lambda *a: _f(*a)[0], arrs, "RNN")
+
+
+# extended coverage (vision/NN, linalg family, tensor extras) registers
+# itself into OP_REGISTRY at import — keep last (it imports from here)
+from . import ops_extended  # noqa: E402,F401

@@ -1,0 +1,459 @@
+"""Extended-op tests (VERDICT r1 #4): forward vs NumPy ground truth +
+check_numeric_gradient, the reference test_operator.py pattern
+(SURVEY.md §4.2)."""
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+rng = onp.random.default_rng(42)
+
+
+def randn(*shape, dtype=onp.float32):
+    return rng.standard_normal(shape).astype(dtype)
+
+
+# -- activations / special functions ----------------------------------------
+def test_special_functions_vs_scipy():
+    from scipy import special
+    x = onp.abs(randn(50)) + 0.5
+    assert_almost_equal(nd.digamma(mx.nd.array(x)), special.digamma(x),
+                        rtol=1e-5, atol=1e-5)
+    assert_almost_equal(nd.erfc(mx.nd.array(x)), special.erfc(x),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_activations_vs_numpy():
+    x = randn(4, 7)
+    a = mx.nd.array(x)
+    assert_almost_equal(nd.hard_sigmoid(a),
+                        onp.clip(0.2 * x + 0.5, 0, 1))
+    assert_almost_equal(nd.softrelu(a), onp.log1p(onp.exp(x)), rtol=1e-5)
+    assert_almost_equal(nd.elu(a, alpha=0.5),
+                        onp.where(x > 0, x, 0.5 * (onp.exp(x) - 1)),
+                        rtol=1e-5)
+    assert_almost_equal(nd.mish(a),
+                        x * onp.tanh(onp.log1p(onp.exp(x))), rtol=1e-5)
+    sm = nd.SoftmaxActivation(a)
+    assert_almost_equal(sm.asnumpy().sum(-1), onp.ones(4), rtol=1e-5)
+
+
+# -- normalization ----------------------------------------------------------
+def test_lrn_vs_numpy():
+    x = randn(2, 7, 3, 3)
+    nsize, alpha, beta, knorm = 5, 1e-2, 0.75, 2.0
+    out = nd.LRN(mx.nd.array(x), alpha=alpha, beta=beta, knorm=knorm,
+                 nsize=nsize).asnumpy()
+    ref = onp.empty_like(x)
+    half = (nsize - 1) // 2
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + nsize - 1 - half + 1)
+        s = (x[:, lo:hi] ** 2).sum(axis=1)
+        ref[:, c] = x[:, c] / (knorm + alpha / nsize * s) ** beta
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_gradient():
+    check_numeric_gradient(
+        lambda x: nd.LRN(x, nsize=3).sum(), [randn(1, 4, 2, 2)])
+
+
+def test_groupnorm_vs_numpy():
+    x = randn(2, 6, 4, 4)
+    g, b = randn(6), randn(6)
+    out = nd.GroupNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                       num_groups=3, eps=1e-5).asnumpy()
+    xg = x.reshape(2, 3, 2, 4, 4)
+    mean = xg.mean(axis=(2, 3, 4), keepdims=True)
+    var = xg.var(axis=(2, 3, 4), keepdims=True)
+    ref = ((xg - mean) / onp.sqrt(var + 1e-5)).reshape(x.shape)
+    ref = ref * g.reshape(1, 6, 1, 1) + b.reshape(1, 6, 1, 1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_groupnorm_gradient():
+    check_numeric_gradient(
+        lambda x, g, b: nd.GroupNorm(x, g, b, num_groups=2).sum(),
+        [randn(1, 4, 3, 3), randn(4), randn(4)])
+
+
+# -- resize / rearrange -----------------------------------------------------
+def test_upsampling_nearest():
+    x = randn(2, 3, 4, 5)
+    out = nd.UpSampling(mx.nd.array(x), scale=2,
+                        sample_type="nearest").asnumpy()
+    ref = x.repeat(2, axis=2).repeat(2, axis=3)
+    assert_almost_equal(out, ref)
+
+
+def test_upsampling_bilinear_shape():
+    x = randn(1, 2, 4, 4)
+    out = nd.UpSampling(mx.nd.array(x), scale=2, sample_type="bilinear",
+                        num_filter=2)
+    assert out.shape == (1, 2, 8, 8)
+    assert bool(onp.isfinite(out.asnumpy()).all())
+
+
+def test_depth_space_round_trip():
+    x = randn(2, 8, 3, 5)
+    d = nd.depth_to_space(mx.nd.array(x), block_size=2)
+    assert d.shape == (2, 2, 6, 10)
+    back = nd.space_to_depth(d, block_size=2)
+    assert_almost_equal(back, x)
+    # spot formula: out[n, c', h*b+i, w*b+j] = in[n, (i*b+j)*C' + c', h, w]
+    dn = d.asnumpy()
+    assert dn[0, 1, 1, 0] == x[0, 2 * 2 + 1, 0, 0]  # i=1, j=0, c'=1
+
+
+def test_bilinear_resize2d():
+    x = randn(1, 1, 4, 4)
+    out = nd.BilinearResize2D(mx.nd.array(x), height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+    # corners align under jax half-pixel resize interiorly; just check
+    # the mean is preserved approximately
+    assert abs(out.asnumpy().mean() - x.mean()) < 0.2
+
+
+def test_crop():
+    x = randn(1, 2, 6, 6)
+    out = nd.Crop(mx.nd.array(x), offset=(1, 2), h_w=(3, 3))
+    assert_almost_equal(out, x[:, :, 1:4, 2:5])
+    like = mx.nd.zeros((1, 2, 4, 4))
+    out2 = nd.Crop(mx.nd.array(x), like, center_crop=True, num_args=2)
+    assert_almost_equal(out2, x[:, :, 1:5, 1:5])
+
+
+# -- sampling-grid family ---------------------------------------------------
+def test_grid_generator_identity_affine():
+    theta = onp.array([[1.0, 0, 0, 0, 1.0, 0]], onp.float32)
+    grid = nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                            target_shape=(3, 5)).asnumpy()
+    assert grid.shape == (1, 2, 3, 5)
+    onp.testing.assert_allclose(grid[0, 0, 0], onp.linspace(-1, 1, 5),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(grid[0, 1, :, 0], onp.linspace(-1, 1, 3),
+                                rtol=1e-5)
+
+
+def test_bilinear_sampler_identity():
+    x = randn(2, 3, 5, 7)
+    theta = onp.tile(onp.array([[1.0, 0, 0, 0, 1.0, 0]], onp.float32),
+                     (2, 1))
+    grid = nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                            target_shape=(5, 7))
+    out = nd.BilinearSampler(mx.nd.array(x), grid)
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_sampler_shift_and_zero_pad():
+    x = onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4)
+    # grid entirely outside → zeros
+    grid = onp.full((1, 2, 2, 2), 5.0, onp.float32)
+    out = nd.BilinearSampler(mx.nd.array(x), mx.nd.array(grid))
+    assert_almost_equal(out, onp.zeros((1, 1, 2, 2)))
+
+
+def test_bilinear_sampler_gradient():
+    check_numeric_gradient(
+        lambda d, g: nd.BilinearSampler(d, g * 0.5).sum(),
+        [randn(1, 2, 4, 4), randn(1, 2, 3, 3)])
+
+
+def test_spatial_transformer_identity():
+    x = randn(1, 2, 4, 4)
+    theta = onp.array([[1.0, 0, 0, 0, 1.0, 0]], onp.float32)
+    out = nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(theta),
+                                target_shape=(4, 4))
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-5)
+
+
+# -- deformable convolution -------------------------------------------------
+def test_deformable_conv_zero_offset_matches_conv():
+    x = randn(2, 3, 6, 6)
+    w = randn(4, 3, 3, 3)
+    off = onp.zeros((2, 2 * 9, 4, 4), onp.float32)
+    out = nd.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), num_filter=4, no_bias=True).asnumpy()
+    ref = nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                         num_filter=4, no_bias=True).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_with_pad_stride_groups():
+    x = randn(1, 4, 5, 5)
+    w = randn(2, 2, 3, 3)          # num_group=2: O=2, C/g=2
+    off = randn(1, 2 * 9, 3, 3) * 0.1
+    out = nd.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), stride=(2, 2), pad=(1, 1), num_filter=2,
+        num_group=2, no_bias=True)
+    assert out.shape == (1, 2, 3, 3)
+    assert bool(onp.isfinite(out.asnumpy()).all())
+
+
+def test_deformable_conv_gradient():
+    check_numeric_gradient(
+        lambda x, o, w: nd.DeformableConvolution(
+            x, o * 0.1, w, kernel=(2, 2), num_filter=2,
+            no_bias=True).sum(),
+        [randn(1, 2, 4, 4), randn(1, 8, 3, 3), randn(2, 2, 2, 2)])
+
+
+# -- correlation ------------------------------------------------------------
+def test_correlation_self_zero_displacement():
+    """corr(x, x) at displacement 0 = mean over channels of x²."""
+    x = randn(1, 4, 6, 6)
+    out = nd.Correlation(mx.nd.array(x), mx.nd.array(x), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1).asnumpy()
+    assert out.shape[1] == 9
+    center = out[:, 4]                     # displacement (0, 0)
+    ref = (x * x).sum(axis=1) / 4.0        # sumelems = K²·C = 4
+    assert_almost_equal(center, ref[:, 1:-1 or None, 1:-1 or None]
+                        if False else ref, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_shifted_planes():
+    """data2 = data1 shifted right by 1 → the (0, +1) displacement
+    channel at interior positions equals mean(x²)."""
+    x = randn(1, 2, 5, 5)
+    x2 = onp.zeros_like(x)
+    x2[:, :, :, 1:] = x[:, :, :, :-1]
+    out = nd.Correlation(mx.nd.array(x), mx.nd.array(x2), kernel_size=1,
+                         max_displacement=1, pad_size=1).asnumpy()
+    # displacement (dy=0, dx=+1): index 5 in the 3x3 grid
+    got = out[0, 5, :, :-1]
+    ref = (x[0] ** 2).sum(axis=0)[:, :-1] / 2.0
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-5)
+
+
+# -- SVMOutput --------------------------------------------------------------
+def test_svm_output_backward_l1():
+    from mxtpu import autograd
+    x = mx.nd.array(onp.array([[2.0, 1.5, -1.0]], onp.float32))
+    label = mx.nd.array(onp.array([0.0], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(x, label, margin=1.0, use_linear=True)
+    out.backward()
+    # margin violations vs class 0 (score 2.0): j=1: 1+1.5-2=0.5>0 → 1
+    # j=2: 1-1-2<0 → 0; grad_y = -1
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                [[-1.0, 1.0, 0.0]], rtol=1e-6)
+
+
+def test_svm_output_backward_l2():
+    from mxtpu import autograd
+    x = mx.nd.array(onp.array([[2.0, 1.5, -1.0]], onp.float32))
+    label = mx.nd.array(onp.array([0.0], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(x, label, margin=1.0, use_linear=False)
+    out.backward()
+    # L2: v_1 = max(0, 1+1.5-2)=0.5, v_2=0 → grad_1 = 2*0.5=1,
+    # grad_0 = -2*(0.5)= -1
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                [[-1.0, 1.0, 0.0]], rtol=1e-6)
+
+
+# -- linalg family ----------------------------------------------------------
+def test_linalg_gemm():
+    a, b, c = randn(3, 4), randn(5, 4), randn(3, 5)
+    out = nd.linalg_gemm(mx.nd.array(a), mx.nd.array(b), mx.nd.array(c),
+                         transpose_b=True, alpha=2.0, beta=0.5)
+    assert_almost_equal(out, 2.0 * a @ b.T + 0.5 * c, rtol=1e-5)
+
+
+def test_linalg_trmm():
+    a, b = randn(4, 4), randn(4, 3)
+    out = nd.linalg_trmm(mx.nd.array(a), mx.nd.array(b), alpha=1.5)
+    assert_almost_equal(out, 1.5 * onp.tril(a) @ b, rtol=1e-5)
+    out2 = nd.linalg_trmm(mx.nd.array(a), mx.nd.array(b.T),
+                          rightside=True, transpose=True)
+    assert_almost_equal(out2, b.T @ onp.tril(a).T, rtol=1e-5)
+
+
+def test_linalg_potrf_potri_round_trip():
+    a = randn(4, 4)
+    spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    L = nd.linalg_potrf(mx.nd.array(spd))
+    inv = nd.linalg_potri(L).asnumpy()
+    assert_almost_equal(inv @ spd, onp.eye(4), rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_sumlogdiag():
+    a = onp.abs(randn(3, 3)) + 1.0
+    out = nd.linalg_sumlogdiag(mx.nd.array(a))
+    assert_almost_equal(out, onp.log(onp.diag(a)).sum(), rtol=1e-5)
+
+
+def test_linalg_diag_trian_round_trips():
+    a = randn(4, 4)
+    d = nd.linalg_extractdiag(mx.nd.array(a), offset=1)
+    assert_almost_equal(d, onp.diag(a, k=1))
+    md = nd.linalg_makediag(d, offset=1).asnumpy()
+    assert_almost_equal(onp.diag(md, k=1), onp.diag(a, k=1))
+    v = nd.linalg_extracttrian(mx.nd.array(a), lower=True)
+    assert v.shape == (10,)
+    back = nd.linalg_maketrian(v, lower=True).asnumpy()
+    assert_almost_equal(back, onp.tril(a))
+
+
+def test_linalg_syevd():
+    a = randn(4, 4)
+    sym = (a + a.T) / 2
+    U, L = nd.linalg_syevd(mx.nd.array(sym))
+    Un, Ln = U.asnumpy(), L.asnumpy()
+    # A = Uᵀ diag(L) U (reference convention: eigenvectors are rows)
+    assert_almost_equal(Un.T @ onp.diag(Ln) @ Un, sym, rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_linalg_det_slogdet_inverse():
+    a = randn(3, 3) + 3 * onp.eye(3, dtype=onp.float32)
+    assert_almost_equal(nd.linalg_det(mx.nd.array(a)),
+                        onp.linalg.det(a), rtol=1e-4)
+    sign, ld = nd.linalg_slogdet(mx.nd.array(a))
+    s_ref, ld_ref = onp.linalg.slogdet(a)
+    assert_almost_equal(sign, s_ref)
+    assert_almost_equal(ld, ld_ref, rtol=1e-4)
+    assert_almost_equal(nd.linalg_inverse(mx.nd.array(a)),
+                        onp.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_gradients():
+    check_numeric_gradient(
+        lambda a, b: nd.linalg_trmm(a, b).sum(), [randn(3, 3), randn(3, 2)])
+    spd = randn(3, 3)
+    spd = spd @ spd.T + 3 * onp.eye(3, dtype=onp.float32)
+    check_numeric_gradient(
+        lambda a: nd.linalg_sumlogdiag(nd.linalg_potrf(a)), [spd],
+        eps=1e-4)
+
+
+# -- tensor extras ----------------------------------------------------------
+def test_histogram():
+    x = randn(100)
+    cnt, edges = nd.histogram(mx.nd.array(x), bins=10, range=(-3, 3))
+    rc, re = onp.histogram(x, bins=10, range=(-3, 3))
+    onp.testing.assert_array_equal(cnt.asnumpy(), rc)
+    assert_almost_equal(edges, re, rtol=1e-5)
+    # explicit edges variant
+    e = onp.linspace(-2, 2, 5).astype(onp.float32)
+    cnt2, _ = nd.histogram(mx.nd.array(x), bins=mx.nd.array(e))
+    rc2, _ = onp.histogram(x, bins=e)
+    onp.testing.assert_array_equal(cnt2.asnumpy(), rc2)
+
+
+def test_khatri_rao():
+    a, b = randn(2, 3), randn(4, 3)
+    out = nd.khatri_rao(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    ref = onp.stack([onp.kron(a[:, i], b[:, i]) for i in range(3)], 1)
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_batch_take_and_argmax_channel():
+    x = randn(4, 6)
+    idx = onp.array([0, 5, 2, 3], onp.int32)
+    out = nd.batch_take(mx.nd.array(x), mx.nd.array(idx))
+    assert_almost_equal(out, x[onp.arange(4), idx])
+    am = nd.argmax_channel(mx.nd.array(x))
+    onp.testing.assert_array_equal(am.asnumpy(), x.argmax(1))
+
+
+def test_broadcast_reshape_like():
+    a = randn(1, 3)
+    b = randn(4, 3)
+    assert_almost_equal(nd.broadcast_like(mx.nd.array(a), mx.nd.array(b)),
+                        onp.broadcast_to(a, (4, 3)))
+    c = randn(2, 6)
+    assert_almost_equal(
+        nd.reshape_like(mx.nd.array(c), mx.nd.array(randn(4, 3))),
+        c.reshape(4, 3))
+
+
+def test_ravel_unravel_round_trip():
+    flat = onp.array([0, 7, 11, 23], onp.int64)
+    shape = (2, 3, 4)
+    coords = nd.unravel_index(mx.nd.array(flat), shape=shape)
+    assert coords.shape == (3, 4)
+    back = nd.ravel_multi_index(coords, shape=shape)
+    onp.testing.assert_array_equal(back.asnumpy().astype(onp.int64), flat)
+
+
+def test_index_add():
+    x = onp.zeros((4, 2), onp.float32)
+    idx = onp.array([1, 1, 3], onp.int32)
+    v = onp.ones((3, 2), onp.float32)
+    out = nd.index_add(mx.nd.array(x), mx.nd.array(idx), mx.nd.array(v))
+    ref = x.copy()
+    onp.add.at(ref, idx, v)
+    assert_almost_equal(out, ref)
+
+
+def test_moments_roll_rot90_ediff1d_searchsorted_index_array():
+    x = randn(3, 4)
+    m, v = nd.moments(mx.nd.array(x), axes=(0,))
+    assert_almost_equal(m, x.mean(0), rtol=1e-5)
+    assert_almost_equal(v, x.var(0), rtol=1e-4)
+    assert_almost_equal(nd.roll(mx.nd.array(x), shift=1, axis=0),
+                        onp.roll(x, 1, 0))
+    assert_almost_equal(nd.rot90(mx.nd.array(x)), onp.rot90(x))
+    assert_almost_equal(nd.ediff1d(mx.nd.array(x)),
+                        onp.diff(x.reshape(-1)))
+    sorted_x = onp.sort(randn(10))
+    q = randn(5)
+    got = nd.searchsorted(mx.nd.array(sorted_x), mx.nd.array(q))
+    onp.testing.assert_array_equal(got.asnumpy(),
+                                   onp.searchsorted(sorted_x, q))
+    ia = nd.index_array(mx.nd.array(x))
+    assert ia.shape == (3, 4, 2)
+    assert ia.asnumpy()[2, 1].tolist() == [2, 1]
+
+
+def test_registry_count_target():
+    """VERDICT r1 #4 exit criterion: registry ≥ 280."""
+    from mxtpu.ndarray.ops import OP_REGISTRY
+    assert len(OP_REGISTRY) >= 280, len(OP_REGISTRY)
+
+
+def test_symbol_sees_extended_ops():
+    """GroupNorm was a dangling _OP_ARRAY_ARGS entry in r1 — the symbol
+    frontend must now compose and execute it."""
+    from mxtpu import sym
+    import mxtpu.symbol as _s
+    data = sym.var("data")
+    gamma = sym.var("gamma")
+    beta = sym.var("beta")
+    out = sym.GroupNorm(data, gamma, beta, num_groups=2)
+    ex = out.bind(mx.cpu(), args={"data": mx.nd.array(randn(2, 4, 3, 3)),
+                        "gamma": mx.nd.ones((4,)),
+                        "beta": mx.nd.zeros((4,))})
+    y = ex.forward()[0]
+    assert y.shape == (2, 4, 3, 3)
+
+
+def test_erfc_tail_and_gelu_exact():
+    from scipy import special
+    x = onp.array([4.0, 5.0, -4.0], onp.float32)
+    got = nd.erfc(mx.nd.array(x)).asnumpy()
+    ref = special.erfc(x.astype(onp.float64))
+    onp.testing.assert_allclose(got, ref, rtol=1e-4)   # no cancellation
+    # gelu must be the exact erf form, agreeing with LeakyReLU('gelu')
+    y = randn(16)
+    a = nd.gelu(mx.nd.array(y)).asnumpy()
+    b = nd.LeakyReLU(mx.nd.array(y), act_type="gelu").asnumpy()
+    onp.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_upsampling_multi_input_common_size():
+    a, b = randn(1, 1, 4, 4), randn(1, 1, 2, 2)
+    out = nd.UpSampling(mx.nd.array(a), mx.nd.array(b), scale=2,
+                        sample_type="nearest", num_args=2)
+    # both inputs reach the common 8x8 target (b gets scale 4)
+    assert out.shape == (1, 2, 8, 8)
+    assert_almost_equal(out.asnumpy()[:, 1:2],
+                        b.repeat(4, axis=2).repeat(4, axis=3))
