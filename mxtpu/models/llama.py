@@ -56,6 +56,11 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     attn_impl: str = "flash"         # flash | dense | ring
     remat: bool = True
+    # None = full per-layer remat; "dots_no_batch" saves weight-matmul
+    # outputs and recomputes only elementwise/attention in the backward
+    # (MaxText-style "minimal" policy: ~25% less recompute FLOPs for a
+    # modest activation-memory increase)
+    remat_policy: Optional[str] = None
     scan_layers: bool = True
     tie_embeddings: bool = False
 
@@ -225,7 +230,16 @@ def forward(cfg: LlamaConfig, params, tokens,
 
     layer = partial(_layer, cfg, mesh, cos, sin)
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        if cfg.remat_policy == "dots_no_batch":
+            layer = jax.checkpoint(
+                layer, policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy is None:
+            layer = jax.checkpoint(layer)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} "
+                "(use None or 'dots_no_batch')")
 
     if cfg.scan_layers:
         def body(x, lp):

@@ -28,6 +28,12 @@ def measure(sizes_mb, iters=10):
     shard = jax.shard_map(psum_fn, mesh=mesh, in_specs=P("x"),
                           out_specs=P())
     jshard = jax.jit(shard)
+    # honest fence: host readback of a scalar — the axon plugin's
+    # block_until_ready can return before the queue drains
+    reduce1 = jax.jit(lambda y: y[0])
+
+    def fence(y):
+        return float(jax.device_get(reduce1(y)))
 
     rows = []
     for mb in sizes_mb:
@@ -36,11 +42,11 @@ def measure(sizes_mb, iters=10):
         x = jax.device_put(
             jnp.ones((elems,), jnp.float32),
             NamedSharding(mesh, P("x")))
-        jshard(x).block_until_ready()          # compile
+        fence(jshard(x))                       # compile
         t0 = time.perf_counter()
         for _ in range(iters):
             out = jshard(x)
-        out.block_until_ready()
+        fence(out)
         dt = (time.perf_counter() - t0) / iters
         nbytes = elems * 4
         algo_bw = (2 * (n - 1) / max(n, 1)) * nbytes / dt / 1e9 \
