@@ -23,10 +23,22 @@ def measure(sizes_mb, iters=10):
     mesh = jax.sharding.Mesh(np.array(devs), ("x",))
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def psum_fn(x):
-        return jax.lax.psum(x, "x")
-    shard = jax.shard_map(psum_fn, mesh=mesh, in_specs=P("x"),
-                          out_specs=P())
+    inv_n = 1.0 / n
+
+    def many_psum(x):
+        # iters collectives INSIDE one program: per-dispatch latency
+        # (~1-5 ms through the axon tunnel, docs/perf.md) would
+        # otherwise swamp the small sizes. pmean keeps magnitude
+        # stable so the chain can't be folded away.
+        def body(_, c):
+            red = jax.lax.psum(c, "x") * jnp.float32(inv_n)
+            # psum output is replicated over x; mark it varying again so
+            # the loop carry type stays stable
+            return jax.lax.pvary(red, ("x",))
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    shard = jax.shard_map(many_psum, mesh=mesh, in_specs=P("x"),
+                          out_specs=P("x"))
     jshard = jax.jit(shard)
     # honest fence: host readback of a scalar — the axon plugin's
     # block_until_ready can return before the queue drains
@@ -44,9 +56,7 @@ def measure(sizes_mb, iters=10):
             NamedSharding(mesh, P("x")))
         fence(jshard(x))                       # compile
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = jshard(x)
-        fence(out)
+        fence(jshard(x))
         dt = (time.perf_counter() - t0) / iters
         nbytes = elems * 4
         algo_bw = (2 * (n - 1) / max(n, 1)) * nbytes / dt / 1e9 \
