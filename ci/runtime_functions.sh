@@ -9,9 +9,14 @@ REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
 
 sanity_check() {
-    # lint: syntax errors + undefined names only (style is not gated)
-    python -m pyflakes mxtpu tools benchmark bench.py __graft_entry__.py \
-        2>/dev/null || python - << 'PYEOF'
+    # lint: syntax errors + undefined names only (style is not gated).
+    # The py_compile fallback runs ONLY when pyflakes is absent — a
+    # pyflakes FAILURE must fail the check.
+    if python -c "import pyflakes" 2>/dev/null; then
+        python -m pyflakes mxtpu tools benchmark bench.py \
+            __graft_entry__.py
+    else
+        python - << 'PYEOF'
 import pathlib, py_compile, sys
 bad = 0
 for p in pathlib.Path(".").rglob("*.py"):
@@ -23,6 +28,7 @@ for p in pathlib.Path(".").rglob("*.py"):
         print(e); bad += 1
 sys.exit(1 if bad else 0)
 PYEOF
+    fi
     echo "sanity_check: OK"
 }
 

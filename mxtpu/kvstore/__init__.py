@@ -187,8 +187,10 @@ def create(name: str = "local") -> KVStore:
     if name in ("local", "device", "nccl", "local_allreduce_cpu",
                 "local_allreduce_device"):
         return KVStore(name)
-    if name in ("dist_sync", "dist_device_sync", "dist_async", "tpu_sync",
-                "horovod"):
+    if name == "dist_async":
+        from .dist import AsyncDistKVStore
+        return AsyncDistKVStore(name)
+    if name in ("dist_sync", "dist_device_sync", "tpu_sync", "horovod"):
         from .dist import DistKVStore
         return DistKVStore(name)
     raise ValueError(f"unknown kvstore type {name!r}")

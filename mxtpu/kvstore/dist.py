@@ -4,10 +4,10 @@ ps-lite [path cites — unverified], SURVEY.md §2.5/§3.4).
 The reference's worker→server push / server→worker pull over ZMQ
 becomes an all-reduce across processes: ``push`` sums each key's value
 over every worker (process_allgather + sum — identical result on all
-ranks, no server role), ``pull`` reads the local aggregate. ``dist_async``
-keeps the API but is synchronous underneath (async PS updates have no
-TPU-native analogue; the reference docs themselves call the semantics
-statistical, SURVEY.md §2.4).
+ranks, no server role), ``pull`` reads the local aggregate.
+``dist_async`` (AsyncDistKVStore below) is a REAL parameter server:
+rank 0 hosts a server thread applying per-push updates with no
+barrier — see mxtpu.kvstore.server.
 """
 from __future__ import annotations
 
@@ -20,7 +20,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from . import KVStore
 
-__all__ = ["DistKVStore"]
+__all__ = ["DistKVStore", "AsyncDistKVStore"]
 
 
 class DistKVStore(KVStore):
@@ -179,3 +179,129 @@ class DistKVStore(KVStore):
             # outputs are committed to local device 0, which would
             # clash with params committed elsewhere
             g._set_data(jax.device_put(r, g._data.sharding))
+
+
+class AsyncDistKVStore(DistKVStore):
+    """``dist_async``: real parameter-server semantics (reference
+    ``kvstore_dist_server.h`` async path — updates applied per push
+    with NO barrier; workers pull whatever has landed). Rank 0 hosts
+    the server thread (mxtpu.kvstore.server); every rank talks to it
+    over TCP. The jitted-psum fast path does NOT apply here by design:
+    async updates are inherently per-key, host-side, unsynchronized."""
+
+    def __init__(self, kv_type: str = "dist_async"):
+        super().__init__(kv_type)
+        from . import server as psrv
+        host, port = psrv.server_address()
+        self._server = None
+        if self.rank == 0:
+            try:
+                self._server = psrv.KVStoreServer(
+                    "0.0.0.0" if jax.process_count() > 1 else host, port)
+            except OSError:
+                # port taken — usually a server from an earlier store in
+                # this process (reference: servers outlive worker-side
+                # KVStore handles). The ping below verifies it actually
+                # speaks this protocol; anything else errors out.
+                pass
+        self._client = psrv.ServerClient(host, port)
+        reply = self._client.request("ping")
+        if len(reply) < 2 or reply[1] != "mxtpu-ps":
+            raise MXNetError(
+                f"service at {host}:{port} is not an mxtpu kvstore "
+                "server (set MXTPU_PS_PORT_OFFSET to relocate)")
+
+    def init(self, key, value) -> None:
+        from ..ndarray import array as _nd_array
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:        # base-class contract
+                raise MXNetError(f"key {k} already initialized")
+            arr = v.asnumpy() if isinstance(v, NDArray) else onp.asarray(v)
+            self._client.request("init", k, arr)
+            self._store[k] = v.copy() if isinstance(v, NDArray) \
+                else _nd_array(arr)
+        self.barrier()      # reference: init is the one synchronized op
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            # same local quantize+sum as every other store (shared
+            # helper — semantics can't diverge), then:
+            # NO barrier, NO cross-worker aggregation — the server
+            # applies this worker's contribution immediately
+            agg = self._local_aggregate(k, v)
+            self._client.request("push", k, agg.asnumpy())
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True) -> None:
+        import jax.numpy as jnp
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            _, val = self._client.request("pull", k)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                new = jnp.asarray(val).astype(t.dtype)
+                if t._data is not None:
+                    # preserve the target's placement (a sharded/pinned
+                    # param must stay so — see allreduce_grads)
+                    new = jax.device_put(new, t._data.sharding)
+                t._set_data(new)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Fetch ONLY the requested rows over the wire (reference
+        sparse PS path: the full embedding never leaves the server)."""
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        if row_ids is None:
+            # all-rows pull; sparse outs get data/indices filled like
+            # the base class, dense outs a plain pull
+            keys, outs = self._normalize(key, out)
+            for k, o in zip(keys, outs):
+                _, val = self._client.request("pull", k)
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    if isinstance(t, RowSparseNDArray):
+                        t.data = NDArray(jnp.asarray(val))
+                        t.indices = NDArray(
+                            jnp.arange(val.shape[0], dtype=jnp.int32))
+                        t._dense_cache = None
+                    else:
+                        self.pull(k, t, priority)
+            return
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) \
+            else [row_ids] * len(keys)
+        if rids and not isinstance(rids[0],
+                                   (list, tuple, NDArray, onp.ndarray)):
+            rids = [rids] * len(keys)
+        for k, o, rid in zip(keys, outs, rids):
+            ids = rid.asnumpy() if isinstance(rid, NDArray) \
+                else onp.asarray(rid)
+            ids = onp.unique(ids.astype(onp.int64))
+            _, got_ids, rows = self._client.request("row_pull", k, ids)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if not isinstance(t, RowSparseNDArray):
+                    raise MXNetError(
+                        "row_sparse_pull with row_ids needs a "
+                        "RowSparseNDArray out")
+                t.data = NDArray(jnp.asarray(rows))
+                t.indices = NDArray(jnp.asarray(got_ids, jnp.int32))
+                t._dense_cache = None
+
+    def set_optimizer(self, optimizer) -> None:
+        """Pickle the optimizer to the server (reference
+        _send_command_to_servers) — updates then run server-side."""
+        import pickle
+        from .. import optimizer as opt
+        if isinstance(optimizer, str):
+            optimizer = opt.create(optimizer)
+        if self.rank == 0:
+            self._client.request("set_optimizer", pickle.dumps(optimizer))
+        self.barrier()
+
+    def set_updater(self, updater) -> None:
+        raise MXNetError(
+            "dist_async runs the updater on the server: use "
+            "set_optimizer (reference kvstore_dist semantics)")

@@ -1,0 +1,230 @@
+"""Asynchronous parameter server — the reference's ``dist_async`` path
+(``src/kvstore/kvstore_dist_server.h`` + ``python/mxnet/
+kvstore_server.py`` [path cites — unverified], SURVEY.md §2.5/§3.4).
+
+Semantics replicated from the reference server:
+
+- **No aggregation barrier**: each worker's push is applied to the
+  store the moment it arrives (server-side updater if an optimizer was
+  set, else accumulate) — workers progress at their own pace and pull
+  whatever mixture of updates has landed (the "statistical" tolerance
+  the reference docs describe).
+- **Server-side optimizer**: ``kv.set_optimizer`` pickles the
+  optimizer to the server, exactly like the reference's
+  ``_send_command_to_servers``.
+- **Sparse row serving**: ``row_sparse_pull`` fetches ONLY the
+  requested rows over the wire — the large-embedding path where the
+  full table never leaves the server.
+
+Topology: the TPU rebuild has no separate server processes (SURVEY
+§7.0: "the server role disappears") — rank 0 hosts the server as a
+daemon thread and every rank (including 0) talks to it over
+localhost/DCN TCP. This keeps the reference's observable semantics
+with one process role.
+
+Wire format: length-prefixed pickles. The server is host-side numpy,
+like the reference's CPU-side server applying ``sgd_update`` on
+aggregated grads.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreServer", "ServerClient", "server_address"]
+
+_LEN = struct.Struct("<Q")
+
+
+def server_address() -> tuple:
+    """(host, port) of the async PS: the DMLC scheduler address with a
+    fixed port offset (the jax.distributed coordinator owns the root
+    port itself)."""
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    return host, port + int(os.environ.get("MXTPU_PS_PORT_OFFSET", "17"))
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("kvstore server connection closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("kvstore server connection closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class KVStoreServer:
+    """The server role: store + per-push updater, no barriers."""
+
+    def __init__(self, host: str, port: int):
+        self._store: Dict[Any, onp.ndarray] = {}
+        self._updater = None
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        with conn:
+            while True:
+                try:
+                    msg = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:      # surface server errors to
+                    reply = ("err", repr(e))  # the pushing worker
+                try:
+                    _send_msg(conn, reply)
+                except (ConnectionError, OSError):
+                    return
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "ping":
+            return ("ok", "mxtpu-ps")
+        if op == "init":
+            _, key, val = msg
+            with self._lock:
+                # first init wins (reference: server keeps worker 0's)
+                if key not in self._store:
+                    self._store[key] = onp.array(val)
+            return ("ok",)
+        if op == "push":
+            _, key, val = msg
+            with self._lock:
+                if key not in self._store:
+                    return ("err", f"key {key!r} not initialized")
+                if self._updater is not None:
+                    # ASYNC: apply immediately, no merge barrier
+                    self._updater(key, onp.asarray(val), self._store[key])
+                else:
+                    self._store[key] = self._store[key] + onp.asarray(val)
+            return ("ok",)
+        if op == "pull":
+            _, key = msg
+            with self._lock:
+                if key not in self._store:
+                    return ("err", f"key {key!r} not initialized")
+                return ("ok", self._store[key].copy())
+        if op == "row_pull":
+            _, key, rows = msg
+            with self._lock:
+                if key not in self._store:
+                    return ("err", f"key {key!r} not initialized")
+                rows = onp.asarray(rows, onp.int64)
+                return ("ok", rows, self._store[key][rows].copy())
+        if op == "set_optimizer":
+            _, blob = msg
+            optimizer = pickle.loads(blob)
+            from .. import optimizer as opt
+            self._updater = _NumpyUpdater(opt.get_updater(optimizer))
+            return ("ok",)
+        if op == "stop":
+            self._running = False
+            try:
+                self._sock.close()
+            finally:
+                return ("ok",)
+        return ("err", f"unknown op {op!r}")
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _NumpyUpdater:
+    """Adapts the frontend Updater (NDArray-based) to the server's
+    numpy store: wraps values, writes the result back in place —
+    the reference server's exec-updater-on-recv step."""
+
+    def __init__(self, updater):
+        self._updater = updater
+
+    def __call__(self, key, grad: onp.ndarray, weight: onp.ndarray):
+        from ..ndarray import array
+        w = array(weight)
+        self._updater(key, array(grad), w)
+        weight[...] = onp.asarray(w.asnumpy(), dtype=weight.dtype)
+
+
+class ServerClient:
+    """Worker-side connection to the async PS (one persistent socket,
+    locked — pushes from one worker are ordered, like one ps-lite
+    customer channel)."""
+
+    def __init__(self, host: Optional[str] = None,
+                 port: Optional[int] = None, timeout: float = 60.0):
+        if host is None or port is None:
+            host, port = server_address()
+        self._addr = (host, port)
+        self._lock = threading.Lock()
+        deadline = time.time() + timeout
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection(self._addr,
+                                                      timeout=timeout)
+                break
+            except OSError as e:       # server may not be up yet
+                last = e
+                if time.time() > deadline:
+                    raise MXNetError(
+                        f"cannot reach kvstore server at {self._addr}: "
+                        f"{last}")
+                time.sleep(0.05)
+
+    def request(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] == "err":
+            raise MXNetError(f"kvstore server: {reply[1]}")
+        return reply
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

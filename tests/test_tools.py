@@ -160,3 +160,94 @@ def test_dist_allreduce_fast_path_matches_veneer(tmp_path):
         capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     assert out.stdout.count("FASTOK") == 2
+
+
+@pytest.mark.slow
+def test_dist_async_kvstore_invariants(tmp_path):
+    """Reference tests/nightly/dist_async_kvstore.py invariants:
+    per-push server-side updates with NO barrier (one worker's push is
+    visible without the other pushing), server-side optimizer via
+    set_optimizer, and row_sparse_pull fetching only requested rows."""
+    worker = tmp_path / "async_worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import mxtpu as mx
+        from mxtpu.parallel import dist
+        dist.initialize()
+        kv = mx.kv.create("dist_async")
+        rank, W = kv.rank, kv.num_workers
+        assert W == 2, W
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                          rescale_grad=1.0))
+
+        if rank == 0:
+            # ONLY rank 0 pushes: async semantics means the update must
+            # be visible to BOTH ranks without rank 1 pushing anything
+            kv.push("w", mx.nd.ones((4,)))
+        kv.barrier()          # order the test, not the update path
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        # sgd with lr 1.0: w = 0 - 1.0 * grad = -1
+        assert np.allclose(out.asnumpy(), -1.0), (rank, out.asnumpy())
+
+        # no-barrier interleaving: both ranks push; total applied
+        # updates = 2 regardless of order
+        kv.push("w", mx.nd.ones((4,)) * 0.5)
+        kv.barrier()
+        kv.pull("w", out=out)
+        assert np.allclose(out.asnumpy(), -2.0), (rank, out.asnumpy())
+
+        # sparse: pull only requested rows of a (8, 3) table
+        kv.init("emb", mx.nd.array(
+            np.arange(24, dtype=np.float32).reshape(8, 3)))
+        from mxtpu.ndarray.sparse import RowSparseNDArray
+        rs = mx.nd.sparse.row_sparse_array(
+            (np.zeros((1, 3), np.float32), [0]), shape=(8, 3))
+        kv.row_sparse_pull("emb", out=rs, row_ids=[5, 2, 5])
+        assert rs.indices.asnumpy().tolist() == [2, 5]
+        assert np.allclose(rs.data.asnumpy(),
+                           [[6, 7, 8], [15, 16, 17]])
+        kv.barrier()
+        print("ASYNCOK", rank, flush=True)
+    """))
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--env", "JAX_PLATFORMS=cpu", "--",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert out.stdout.count("ASYNCOK") == 2
+
+
+def test_dist_async_single_process():
+    """dist_async on one process still provides PS semantics (server
+    thread + loopback client)."""
+    import numpy as np
+    import mxtpu as mx
+    kv = mx.kv.create("dist_async")
+    kv.init(9, mx.nd.ones((3,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
+                                      rescale_grad=1.0))
+    kv.push(9, mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5 * np.ones(3))
+    with pytest.raises(Exception):
+        kv.set_updater(lambda k, g, w: None)
+    # duplicate init keeps the base-class contract
+    with pytest.raises(Exception):
+        kv.init(9, mx.nd.ones((3,)))
+    # row_sparse_pull without row_ids fills ALL rows on a sparse out
+    kv.init("tbl", mx.nd.array(np.arange(6, dtype=np.float32)
+                               .reshape(3, 2)))
+    rs = mx.nd.sparse.row_sparse_array(
+        (np.zeros((1, 2), np.float32), [0]), shape=(3, 2))
+    kv.row_sparse_pull("tbl", out=rs)
+    assert rs.indices.asnumpy().tolist() == [0, 1, 2]
+    np.testing.assert_allclose(rs.data.asnumpy(),
+                               np.arange(6).reshape(3, 2))
