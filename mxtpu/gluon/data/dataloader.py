@@ -1,15 +1,24 @@
 """DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``
 [path cite]).
 
-The reference forks multiprocessing workers that decode into POSIX
-shared-memory NDArrays. Under PJRT the device owns transfers, so the
-TPU-native design is a *threaded* prefetch pipeline (this box: 1 CPU core;
-multi-worker adds only overhead) feeding ready host batches that
-device_put overlaps with compute. ``num_workers`` maps to prefetch
-threads; the batchify API is preserved exactly.
+Worker model, mirroring the reference:
+
+- ``num_workers == 0`` — load in the iterating thread (with optional
+  background prefetch threads via ``prefetch``).
+- ``num_workers > 0, thread_pool=True`` — threaded prefetch pipeline.
+  On this 1-core box (and generally under PJRT, where the device owns
+  transfers) this is the recommended fast path.
+- ``num_workers > 0, thread_pool=False`` — REAL forked worker
+  processes (the reference's multiprocessing pool + shared-memory
+  NDArray IPC). Workers batchify with ``default_mp_batchify_fn``
+  (numpy — forked children must not touch the PJRT device) and ship
+  batches back to the parent, which converts to NDArray. Datasets must
+  yield numpy-convertible samples on this path; use ``thread_pool``
+  for datasets whose transforms need device ops.
 """
 from __future__ import annotations
 
+import multiprocessing as _mp
 import queue as _queue
 import threading
 from typing import Callable, List, Optional
@@ -35,7 +44,40 @@ def default_batchify_fn(data):
     return nd.array(out, dtype=out.dtype)
 
 
-default_mp_batchify_fn = default_batchify_fn  # no mp path under PJRT
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: numpy only (reference's variant built
+    shared-memory NDArrays; forked children here must stay off the
+    PJRT device, so batches cross the process boundary as numpy)."""
+    if isinstance(data[0], tuple):
+        return [default_mp_batchify_fn(list(i)) for i in zip(*data)]
+    arrs = [x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            for x in data]
+    return _np.stack(arrs)
+
+
+def _np_to_nd(batch):
+    if isinstance(batch, (list, tuple)):
+        return [_np_to_nd(b) for b in batch]
+    if isinstance(batch, _np.ndarray):
+        return nd.array(batch, dtype=batch.dtype)
+    return batch
+
+
+# worker-process globals (set once per worker by the fork initializer —
+# the reference passes the dataset the same way, riding fork COW)
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_init(dataset, batchify_fn):
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+
+
+def _worker_fn(indices):
+    samples = [_worker_dataset[i] for i in indices]
+    return _worker_batchify(samples)
 
 
 class DataLoader:
@@ -67,7 +109,11 @@ class DataLoader:
                 "batch_size/shuffle/sampler/last_batch are exclusive with "
                 "batch_sampler")
         self._batch_sampler = batch_sampler
-        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
+        self._mp = self._num_workers > 0 and not thread_pool
+        self._batchify_fn = batchify_fn or (
+            default_mp_batchify_fn if self._mp else default_batchify_fn)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(1, num_workers))
         self._timeout = timeout
@@ -79,7 +125,64 @@ class DataLoader:
         samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
 
+    def _check_mp_safe(self):
+        """Probe ONE sample in the parent: device-backed samples would
+        make the forked child touch the PJRT client (deadlock risk on
+        TPU) — fail loudly with the fix instead."""
+        import jax
+        if len(self._dataset) == 0 or jax.default_backend() == "cpu":
+            return
+        sample = self._dataset[0]
+        parts = sample if isinstance(sample, tuple) else (sample,)
+        if any(isinstance(x, NDArray) for x in parts):
+            raise ValueError(
+                "DataLoader(num_workers>0) forks worker processes, but "
+                "this dataset yields device-backed NDArrays — forked "
+                "children must not touch the TPU. Use thread_pool=True "
+                "or make the dataset/transforms yield numpy.")
+
+    @property
+    def _pool(self):
+        """Worker pool, forked once and reused across epochs (the
+        reference creates its pool in __init__)."""
+        pool = getattr(self, "_pool_cache", None)
+        if pool is None:
+            ctx = _mp.get_context("fork")
+            pool = ctx.Pool(self._num_workers, initializer=_worker_init,
+                            initargs=(self._dataset, self._batchify_fn))
+            self._pool_cache = pool
+        return pool
+
+    def __del__(self):
+        pool = getattr(self, "_pool_cache", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass
+
+    def _iter_multiprocess(self):
+        """Forked worker pool: batches built in child processes
+        (numpy), converted to NDArray in the parent — the reference's
+        multiprocessing DataLoader shape. imap preserves batch order,
+        so output matches the single-process iterator exactly."""
+        self._check_mp_safe()
+        it = self._pool.imap(_worker_fn, iter(self._batch_sampler))
+        while True:
+            try:
+                batch = it.next(self._timeout)
+            except StopIteration:
+                return
+            except _mp.TimeoutError:
+                raise RuntimeError(
+                    f"DataLoader worker timed out after "
+                    f"{self._timeout}s (dead or stuck worker)")
+            yield _np_to_nd(batch)
+
     def __iter__(self):
+        if self._mp:
+            yield from self._iter_multiprocess()
+            return
         if self._prefetch == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)

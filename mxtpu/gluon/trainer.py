@@ -116,11 +116,36 @@ class Trainer:
                 self._amp_unscaled = False
                 return
         self._optimizer.rescale_grad = self._scale / batch_size
-        self.allreduce_grads()
-        self.update(batch_size, ignore_stale_grad)
+        if getattr(self._kvstore, "update_on_kvstore", False):
+            # parameter-server path (dist_async): the SERVER runs the
+            # optimizer on each pushed grad, no local update
+            self._step_on_kvstore()
+        else:
+            self.allreduce_grads()
+            self.update(batch_size, ignore_stale_grad)
         if scaler is not None:
             self._scale = self._amp_original_scale
             self._amp_unscaled = False
+
+    def _step_on_kvstore(self) -> None:
+        """Push grads / pull weights per parameter (reference
+        Module/Trainer with update_on_kvstore: the server applies the
+        optimizer the moment each push arrives — async semantics)."""
+        kv = self._kvstore
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null" and p._data is not None]
+        if not getattr(self, "_kv_params_on_server", False):
+            kv.init([i for i, _ in live], [p.data() for _, p in live])
+            # rescale_grad is already set for this step; the server's
+            # pickled optimizer copy carries it (reference pickles the
+            # optimizer to servers once, at init_optimizer)
+            kv.set_optimizer(self._optimizer)
+            for i, p in live:     # adopt the server's (rank-0) values
+                kv.pull(i, out=p.data())
+            self._kv_params_on_server = True
+        for i, p in live:
+            kv.push(i, p.grad())
+            kv.pull(i, out=p.data())
 
     def allreduce_grads(self) -> None:
         if self._kvstore is not None and hasattr(self._kvstore,

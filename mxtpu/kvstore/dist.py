@@ -189,6 +189,10 @@ class AsyncDistKVStore(DistKVStore):
     over TCP. The jitted-psum fast path does NOT apply here by design:
     async updates are inherently per-key, host-side, unsynchronized."""
 
+    # Trainer routes steps through push/pull so the server applies the
+    # updates (reference update_on_kvstore=True for dist stores)
+    update_on_kvstore = True
+
     def __init__(self, kv_type: str = "dist_async"):
         super().__init__(kv_type)
         from . import server as psrv
@@ -210,6 +214,11 @@ class AsyncDistKVStore(DistKVStore):
             raise MXNetError(
                 f"service at {host}:{port} is not an mxtpu kvstore "
                 "server (set MXTPU_PS_PORT_OFFSET to relocate)")
+        if self.rank == 0 and self._server is None:
+            # reusing an in-process server from an earlier store: a new
+            # store is a new session — clear stale keys + optimizer
+            self._client.request("reset")
+        self.barrier()      # reset lands before any other rank inits
 
     def init(self, key, value) -> None:
         from ..ndarray import array as _nd_array

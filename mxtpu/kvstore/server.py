@@ -59,6 +59,9 @@ def _send_msg(sock: socket.socket, obj: Any) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
+_MAX_FRAME = 1 << 33    # 8 GB: anything larger is a foreign protocol
+
+
 def _recv_msg(sock: socket.socket) -> Any:
     hdr = b""
     while len(hdr) < _LEN.size:
@@ -67,6 +70,10 @@ def _recv_msg(sock: socket.socket) -> Any:
             raise ConnectionError("kvstore server connection closed")
         hdr += chunk
     (n,) = _LEN.unpack(hdr)
+    if n > _MAX_FRAME:
+        raise ConnectionError(
+            f"implausible frame length {n} — peer is not an mxtpu "
+            "kvstore server")
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
@@ -121,6 +128,14 @@ class KVStoreServer:
         op = msg[0]
         if op == "ping":
             return ("ok", "mxtpu-ps")
+        if op == "reset":
+            # a NEW store session is starting: drop stale keys and the
+            # previous optimizer so a reused in-process server can't
+            # silently serve the last session's state
+            with self._lock:
+                self._store.clear()
+                self._updater = None
+            return ("ok",)
         if op == "init":
             _, key, val = msg
             with self._lock:
@@ -154,9 +169,7 @@ class KVStoreServer:
                 return ("ok", rows, self._store[key][rows].copy())
         if op == "set_optimizer":
             _, blob = msg
-            optimizer = pickle.loads(blob)
-            from .. import optimizer as opt
-            self._updater = _NumpyUpdater(opt.get_updater(optimizer))
+            self._updater = _NumpyUpdater(pickle.loads(blob))
             return ("ok",)
         if op == "stop":
             self._running = False
@@ -175,14 +188,31 @@ class KVStoreServer:
 
 
 class _NumpyUpdater:
-    """Adapts the frontend Updater (NDArray-based) to the server's
-    numpy store: wraps values, writes the result back in place —
-    the reference server's exec-updater-on-recv step."""
+    """Runs the optimizer against the server's numpy store — the
+    reference server's exec-updater-on-recv step. Plain SGD (the
+    typical PS optimizer) executes in pure numpy so a push never
+    touches the device from the server thread; other optimizers fall
+    back to the NDArray updater (one device round trip per push)."""
 
-    def __init__(self, updater):
-        self._updater = updater
+    def __init__(self, optimizer):
+        from .. import optimizer as opt
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        self._is_plain_sgd = (
+            type(optimizer).__name__ == "SGD"
+            and getattr(optimizer, "momentum", 0.0) in (0.0, None))
 
     def __call__(self, key, grad: onp.ndarray, weight: onp.ndarray):
+        o = self._optimizer
+        if self._is_plain_sgd:
+            lr = o.learning_rate
+            g = grad * getattr(o, "rescale_grad", 1.0)
+            clip = getattr(o, "clip_gradient", None)
+            if clip:
+                g = onp.clip(g, -clip, clip)
+            wd = getattr(o, "wd", 0.0)
+            weight -= lr * (g + wd * weight)
+            return
         from ..ndarray import array
         w = array(weight)
         self._updater(key, array(grad), w)

@@ -41,7 +41,11 @@ def sharded_embedding_lookup(table, ids, mesh: Mesh,
     gather).
     """
     if axis not in mesh.axis_names:
-        return table[ids]
+        # match the sharded path's out-of-range semantics (zeros), not
+        # gather's default clamp — same inputs, same numerics
+        valid = (ids >= 0) & (ids < table.shape[0])
+        vals = table[jnp.clip(ids, 0, table.shape[0] - 1)]
+        return jnp.where(valid[..., None], vals, 0)
 
     # every OTHER mesh axis is irrelevant to the table: keep the ids
     # and output replicated over them

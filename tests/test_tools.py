@@ -251,3 +251,34 @@ def test_dist_async_single_process():
     assert rs.indices.asnumpy().tolist() == [0, 1, 2]
     np.testing.assert_allclose(rs.data.asnumpy(),
                                np.arange(6).reshape(3, 2))
+
+
+def test_trainer_update_on_kvstore_async():
+    """Trainer with dist_async routes updates THROUGH the server
+    (push grad -> server-side SGD -> pull weight); no local update."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import gluon, autograd
+    from mxtpu.gluon import nn
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    kv = mx.kv.create("dist_async")
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=kv)
+    x = mx.nd.array(np.ones((4, 2), np.float32))
+    w0 = net.weight.data().asnumpy()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)
+    w1 = net.weight.data().asnumpy()
+    # dL/dW = sum_b x = 4 per element, rescaled by 1/4 -> grad 1;
+    # server SGD: w - 0.1 * 1
+    np.testing.assert_allclose(w1, w0 - 0.1, rtol=1e-5)
+    # second step: server state persists, same delta again
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0 - 0.2,
+                               rtol=1e-5)
