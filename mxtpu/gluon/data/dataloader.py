@@ -164,19 +164,35 @@ class DataLoader:
     def _iter_multiprocess(self):
         """Forked worker pool: batches built in child processes
         (numpy), converted to NDArray in the parent — the reference's
-        multiprocessing DataLoader shape. imap preserves batch order,
-        so output matches the single-process iterator exactly."""
+        multiprocessing DataLoader shape. A bounded window of
+        apply_async tasks gives backpressure (imap would eagerly
+        compute and buffer the whole epoch) while preserving batch
+        order."""
+        import collections
         self._check_mp_safe()
-        it = self._pool.imap(_worker_fn, iter(self._batch_sampler))
-        while True:
+        pool = self._pool
+        window = max(self._prefetch, self._num_workers)
+        pending = collections.deque()
+        sampler_it = iter(self._batch_sampler)
+
+        def fill():
+            while len(pending) < window:
+                try:
+                    indices = next(sampler_it)
+                except StopIteration:
+                    return
+                pending.append(pool.apply_async(_worker_fn, (indices,)))
+
+        fill()
+        while pending:
+            res = pending.popleft()
             try:
-                batch = it.next(self._timeout)
-            except StopIteration:
-                return
+                batch = res.get(self._timeout)
             except _mp.TimeoutError:
                 raise RuntimeError(
                     f"DataLoader worker timed out after "
                     f"{self._timeout}s (dead or stuck worker)")
+            fill()
             yield _np_to_nd(batch)
 
     def __iter__(self):

@@ -128,24 +128,28 @@ class Trainer:
             self._amp_unscaled = False
 
     def _step_on_kvstore(self) -> None:
-        """Push grads / pull weights per parameter (reference
-        Module/Trainer with update_on_kvstore: the server applies the
-        optimizer the moment each push arrives — async semantics)."""
+        """Push grads / pull weights (reference Module/Trainer with
+        update_on_kvstore: the server applies the optimizer the moment
+        each push arrives — async semantics). Batched: one push
+        message + one pull message per step, not 2N round trips."""
         kv = self._kvstore
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null" and p._data is not None]
+        keys = [i for i, _ in live]
+        hp = (self._optimizer.rescale_grad, self._optimizer.learning_rate)
         if not getattr(self, "_kv_params_on_server", False):
-            kv.init([i for i, _ in live], [p.data() for _, p in live])
-            # rescale_grad is already set for this step; the server's
-            # pickled optimizer copy carries it (reference pickles the
-            # optimizer to servers once, at init_optimizer)
+            kv.init(keys, [p.data() for _, p in live])
             kv.set_optimizer(self._optimizer)
-            for i, p in live:     # adopt the server's (rank-0) values
-                kv.pull(i, out=p.data())
+            self._kv_server_hp = hp
+            kv.pull_many(keys, [p.data() for _, p in live])
             self._kv_params_on_server = True
-        for i, p in live:
-            kv.push(i, p.grad())
-            kv.pull(i, out=p.data())
+        elif getattr(self, "_kv_server_hp", None) != hp:
+            # rescale_grad (batch size / AMP scale) or lr changed since
+            # the server's optimizer copy was pickled — refresh it
+            kv.set_optimizer(self._optimizer)
+            self._kv_server_hp = hp
+        kv.push_many(keys, [p.grad() for _, p in live])
+        kv.pull_many(keys, [p.data() for _, p in live])
 
     def allreduce_grads(self) -> None:
         if self._kvstore is not None and hasattr(self._kvstore,

@@ -193,6 +193,12 @@ class AsyncDistKVStore(DistKVStore):
     # updates (reference update_on_kvstore=True for dist stores)
     update_on_kvstore = True
 
+    # per-process creation counter: the Nth store created in each
+    # process shares the server-side namespace N (SPMD programs create
+    # stores in lockstep), so a second store can coexist with a live
+    # first one instead of clobbering its keys
+    _session_counter = 0
+
     def __init__(self, kv_type: str = "dist_async"):
         super().__init__(kv_type)
         from . import server as psrv
@@ -214,11 +220,13 @@ class AsyncDistKVStore(DistKVStore):
             raise MXNetError(
                 f"service at {host}:{port} is not an mxtpu kvstore "
                 "server (set MXTPU_PS_PORT_OFFSET to relocate)")
-        if self.rank == 0 and self._server is None:
-            # reusing an in-process server from an earlier store: a new
-            # store is a new session — clear stale keys + optimizer
-            self._client.request("reset")
-        self.barrier()      # reset lands before any other rank inits
+        self._ns = AsyncDistKVStore._session_counter
+        AsyncDistKVStore._session_counter += 1
+        self.barrier()
+
+    def _k(self, key):
+        """Server-side key, namespaced per store session."""
+        return (self._ns, key)
 
     def init(self, key, value) -> None:
         from ..ndarray import array as _nd_array
@@ -227,7 +235,7 @@ class AsyncDistKVStore(DistKVStore):
             if k in self._store:        # base-class contract
                 raise MXNetError(f"key {k} already initialized")
             arr = v.asnumpy() if isinstance(v, NDArray) else onp.asarray(v)
-            self._client.request("init", k, arr)
+            self._client.request("init", self._k(k), arr)
             self._store[k] = v.copy() if isinstance(v, NDArray) \
                 else _nd_array(arr)
         self.barrier()      # reference: init is the one synchronized op
@@ -240,14 +248,14 @@ class AsyncDistKVStore(DistKVStore):
             # NO barrier, NO cross-worker aggregation — the server
             # applies this worker's contribution immediately
             agg = self._local_aggregate(k, v)
-            self._client.request("push", k, agg.asnumpy())
+            self._client.request("push", self._k(k), agg.asnumpy())
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
         import jax.numpy as jnp
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
-            _, val = self._client.request("pull", k)
+            _, val = self._client.request("pull", self._k(k))
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 new = jnp.asarray(val).astype(t.dtype)
@@ -267,7 +275,7 @@ class AsyncDistKVStore(DistKVStore):
             # the base class, dense outs a plain pull
             keys, outs = self._normalize(key, out)
             for k, o in zip(keys, outs):
-                _, val = self._client.request("pull", k)
+                _, val = self._client.request("pull", self._k(k))
                 targets = o if isinstance(o, (list, tuple)) else [o]
                 for t in targets:
                     if isinstance(t, RowSparseNDArray):
@@ -288,7 +296,7 @@ class AsyncDistKVStore(DistKVStore):
             ids = rid.asnumpy() if isinstance(rid, NDArray) \
                 else onp.asarray(rid)
             ids = onp.unique(ids.astype(onp.int64))
-            _, got_ids, rows = self._client.request("row_pull", k, ids)
+            _, got_ids, rows = self._client.request("row_pull", self._k(k), ids)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 if not isinstance(t, RowSparseNDArray):
@@ -299,15 +307,36 @@ class AsyncDistKVStore(DistKVStore):
                 t.indices = NDArray(jnp.asarray(got_ids, jnp.int32))
                 t._dense_cache = None
 
+    def push_many(self, keys, values) -> None:
+        """Batched push: ONE message for all keys (vs the per-key RTT
+        of push) — the reference's multi-key ZPush."""
+        pairs = [(self._k(k), v.asnumpy()) for k, v in zip(keys, values)]
+        self._client.request("push_many", pairs)
+
+    def pull_many(self, keys, outs) -> None:
+        """Batched pull: one message, preserving each out's placement."""
+        import jax.numpy as jnp
+        _, vals = self._client.request(
+            "pull_many", [self._k(k) for k in keys])
+        for t, val in zip(outs, vals):
+            new = jnp.asarray(val).astype(t.dtype)
+            if t._data is not None:
+                new = jax.device_put(new, t._data.sharding)
+            t._set_data(new)
+
     def set_optimizer(self, optimizer) -> None:
         """Pickle the optimizer to the server (reference
-        _send_command_to_servers) — updates then run server-side."""
+        _send_command_to_servers) — updates then run server-side.
+        Call again whenever hyperparameters change (the Trainer does
+        this on rescale/lr changes); the server keeps its per-key
+        update counts across optimizer refreshes."""
         import pickle
         from .. import optimizer as opt
         if isinstance(optimizer, str):
             optimizer = opt.create(optimizer)
         if self.rank == 0:
-            self._client.request("set_optimizer", pickle.dumps(optimizer))
+            self._client.request("set_optimizer", self._ns,
+                                 pickle.dumps(optimizer))
         self.barrier()
 
     def set_updater(self, updater) -> None:

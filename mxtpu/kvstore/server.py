@@ -88,7 +88,10 @@ class KVStoreServer:
 
     def __init__(self, host: str, port: int):
         self._store: Dict[Any, onp.ndarray] = {}
-        self._updater = None
+        # one updater per client session namespace (keys arrive as
+        # (ns, name) tuples): two live stores must not share an
+        # optimizer any more than they share keys
+        self._updaters: Dict[Any, Any] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -129,12 +132,9 @@ class KVStoreServer:
         if op == "ping":
             return ("ok", "mxtpu-ps")
         if op == "reset":
-            # a NEW store session is starting: drop stale keys and the
-            # previous optimizer so a reused in-process server can't
-            # silently serve the last session's state
             with self._lock:
                 self._store.clear()
-                self._updater = None
+                self._updaters.clear()
             return ("ok",)
         if op == "init":
             _, key, val = msg
@@ -146,20 +146,28 @@ class KVStoreServer:
         if op == "push":
             _, key, val = msg
             with self._lock:
-                if key not in self._store:
-                    return ("err", f"key {key!r} not initialized")
-                if self._updater is not None:
-                    # ASYNC: apply immediately, no merge barrier
-                    self._updater(key, onp.asarray(val), self._store[key])
-                else:
-                    self._store[key] = self._store[key] + onp.asarray(val)
-            return ("ok",)
+                return self._push_one(key, val)
         if op == "pull":
             _, key = msg
             with self._lock:
                 if key not in self._store:
                     return ("err", f"key {key!r} not initialized")
                 return ("ok", self._store[key].copy())
+        if op == "push_many":
+            _, pairs = msg
+            with self._lock:
+                for key, val in pairs:
+                    r = self._push_one(key, val)
+                    if r[0] == "err":
+                        return r
+            return ("ok",)
+        if op == "pull_many":
+            _, keys = msg
+            with self._lock:
+                missing = [k for k in keys if k not in self._store]
+                if missing:
+                    return ("err", f"keys {missing!r} not initialized")
+                return ("ok", [self._store[k].copy() for k in keys])
         if op == "row_pull":
             _, key, rows = msg
             with self._lock:
@@ -168,8 +176,16 @@ class KVStoreServer:
                 rows = onp.asarray(rows, onp.int64)
                 return ("ok", rows, self._store[key][rows].copy())
         if op == "set_optimizer":
-            _, blob = msg
-            self._updater = _NumpyUpdater(pickle.loads(blob))
+            _, ns, blob = msg
+            new = _NumpyUpdater(pickle.loads(blob))
+            old = self._updaters.get(ns)
+            if old is not None and hasattr(old, "_optimizer"):
+                # hyperparameter refresh, not a restart: keep the
+                # schedule position (per-key update counts)
+                new._optimizer._index_update_count = dict(
+                    old._optimizer._index_update_count)
+                new._optimizer.num_update = old._optimizer.num_update
+            self._updaters[ns] = new
             return ("ok",)
         if op == "stop":
             self._running = False
@@ -178,6 +194,22 @@ class KVStoreServer:
             finally:
                 return ("ok",)
         return ("err", f"unknown op {op!r}")
+
+    def _push_one(self, key, val):
+        """Apply one pushed value (lock held): session updater if the
+        namespace set one, else accumulate."""
+        if key not in self._store:
+            return ("err", f"key {key!r} not initialized")
+        ns = key[0] if isinstance(key, tuple) and len(key) == 2 else None
+        updater = self._updaters.get(ns)
+        if updater is not None:
+            # ASYNC: apply immediately, no merge barrier; updaters key
+            # their state by the bare name
+            updater(key[1] if ns is not None else key,
+                    onp.asarray(val), self._store[key])
+        else:
+            self._store[key] = self._store[key] + onp.asarray(val)
+        return ("ok",)
 
     def stop(self):
         self._running = False
@@ -205,12 +237,15 @@ class _NumpyUpdater:
     def __call__(self, key, grad: onp.ndarray, weight: onp.ndarray):
         o = self._optimizer
         if self._is_plain_sgd:
-            lr = o.learning_rate
+            # same bookkeeping as Optimizer.update: per-index update
+            # counts (drives lr schedulers) and per-param lr/wd mults
+            o._update_count(key)
+            lr = o._get_lr(key)
+            wd = o._get_wd(key)
             g = grad * getattr(o, "rescale_grad", 1.0)
             clip = getattr(o, "clip_gradient", None)
             if clip:
                 g = onp.clip(g, -clip, clip)
-            wd = getattr(o, "wd", 0.0)
             weight -= lr * (g + wd * weight)
             return
         from ..ndarray import array

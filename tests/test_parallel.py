@@ -250,3 +250,88 @@ def test_sharded_embedding_lookup_matches_dense_and_grads():
     g_dense = jax.grad(loss_dense)(jnp.asarray(table_h))
     np.testing.assert_allclose(np.asarray(g_sharded),
                                np.asarray(g_dense), rtol=1e-5)
+
+
+def test_gpipe_matches_sequential_llama_layers():
+    """VERDICT r1 #9: pp=2 GPipe schedule over llama-tiny's layer stack
+    matches the 1-stage sequential numerics, forward AND backward."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dataclasses import replace
+    from mxtpu.models import llama
+    from mxtpu.parallel import mesh as pmesh
+    from mxtpu.parallel.pipeline import gpipe
+
+    cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                  attn_impl="dense", remat=False, n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    layers = params["layers"]
+    B, Ssq, D = 4, 16, cfg.dim
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, Ssq, D),
+                          jnp.float32)
+    cos, sin = llama.rope_tables(cfg, Ssq)
+
+    def layer_fn(lp, xx):
+        return llama._layer(cfg, None, cos, sin, xx, lp)
+
+    def seq_apply(layers_p, xx):
+        def body(c, lp):
+            return layer_fn(lp, c), None
+        return jax.lax.scan(body, xx, layers_p)[0]
+
+    ref = seq_apply(layers, x)
+
+    mesh = pmesh.create_mesh(dp=1, pp=2, devices=jax.devices()[:2])
+    out = jax.jit(lambda lp, xx: gpipe(
+        layer_fn, lp, xx, mesh=mesh, n_microbatches=2, axis="pp"))(
+            layers, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # backward through the pipeline == backward through the stack
+    g_ref = jax.grad(lambda lp: (seq_apply(lp, x) ** 2).sum())(layers)
+    g_pp = jax.jit(jax.grad(lambda lp: (gpipe(
+        layer_fn, lp, x, mesh=mesh, n_microbatches=2,
+        axis="pp") ** 2).sum()))(layers)
+    for kk in g_ref:
+        np.testing.assert_allclose(
+            np.asarray(g_pp[kk]), np.asarray(g_ref[kk]),
+            rtol=5e-4, atol=5e-5, err_msg=kk)
+
+
+def test_gpipe_four_stages_and_s1_fallback():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxtpu.parallel import mesh as pmesh
+    from mxtpu.parallel.pipeline import gpipe
+
+    # simple affine layers: y = x @ w + b
+    L, D = 8, 6
+    k = jax.random.PRNGKey(0)
+    ws = jax.random.normal(k, (L, D, D)) * 0.1
+    bs = jax.random.normal(jax.random.PRNGKey(1), (L, D)) * 0.1
+    params = {"w": ws, "b": bs}
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, D))
+
+    def layer_fn(lp, xx):
+        return jnp.tanh(xx @ lp["w"] + lp["b"])
+
+    def seq(xx):
+        for i in range(L):
+            xx = layer_fn({"w": ws[i], "b": bs[i]}, xx)
+        return xx
+    ref = seq(x)
+
+    mesh4 = pmesh.create_mesh(dp=1, pp=4, devices=jax.devices()[:4])
+    out4 = gpipe(layer_fn, params, x, mesh=mesh4, n_microbatches=4,
+                 axis="pp")
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # S=1 mesh: plain scan fallback
+    mesh1 = pmesh.create_mesh(dp=1, devices=jax.devices()[:1])
+    out1 = gpipe(layer_fn, params, x, mesh=mesh1, n_microbatches=2,
+                 axis="pp")
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)

@@ -282,3 +282,20 @@ def test_trainer_update_on_kvstore_async():
     tr.step(4)
     np.testing.assert_allclose(net.weight.data().asnumpy(), w0 - 0.2,
                                rtol=1e-5)
+
+
+def test_two_async_stores_coexist():
+    """Session namespacing: a second dist_async store must not clobber
+    a live first store's keys or optimizer."""
+    import numpy as np
+    import mxtpu as mx
+    kv1 = mx.kv.create("dist_async")
+    kv1.init("shared_name", mx.nd.ones((2,)))
+    kv2 = mx.kv.create("dist_async")
+    kv2.init("shared_name", mx.nd.zeros((2,)))   # same name, own ns
+    kv1.push("shared_name", mx.nd.ones((2,)))    # accumulate: 1+1
+    o1, o2 = mx.nd.zeros((2,)), mx.nd.zeros((2,))
+    kv1.pull("shared_name", out=o1)
+    kv2.pull("shared_name", out=o2)
+    np.testing.assert_allclose(o1.asnumpy(), [2, 2])
+    np.testing.assert_allclose(o2.asnumpy(), [0, 0])
