@@ -1,0 +1,33 @@
+"""dist_async parameter server demo (reference example/ ps usage):
+server-side optimizer, per-push updates, sparse row pulls.
+Run: python example/kvstore/async_ps.py
+"""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), '..', '..'))  # repo-root import
+import numpy as np
+
+import mxtpu as mx
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    print(f"rank {kv.rank}/{kv.num_workers}")
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
+                                      rescale_grad=1.0))
+    for i in range(4):
+        kv.push("w", mx.nd.ones((4,)))     # applied on arrival
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    print("after 4 async pushes:", out.asnumpy())
+
+    kv.init("emb", mx.nd.array(
+        np.arange(40, dtype=np.float32).reshape(10, 4)))
+    rs = mx.nd.sparse.row_sparse_array(
+        (np.zeros((1, 4), np.float32), [0]), shape=(10, 4))
+    kv.row_sparse_pull("emb", out=rs, row_ids=[2, 7])
+    print("sparse rows pulled:", rs.indices.asnumpy().tolist())
+
+
+if __name__ == "__main__":
+    main()

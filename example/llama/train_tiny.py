@@ -1,0 +1,39 @@
+"""Flagship Llama family: tiny causal-LM trained on a toy corpus with
+the sharded train step (BASELINE config 5 shape, runnable on one chip
+or the 8-device CPU mesh). Run: python example/llama/train_tiny.py
+"""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), '..', '..'))  # repo-root import
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from mxtpu.models import llama
+from mxtpu.parallel import mesh as pmesh, step as pstep
+
+
+def main():
+    cfg = llama.CONFIGS["tiny"]
+    mesh = pmesh.create_mesh(dp=-1)
+    rules = llama.sharding_rules(cfg)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.adamw(1e-2)
+    state = pstep.init_state(params, tx, mesh, rules)
+    step = pstep.make_train_step(llama.loss_fn(cfg), tx, mesh, rules)
+
+    # toy corpus: repeated arithmetic-progression sequences
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, 200, (32, 1))
+    tokens = jnp.asarray((starts + np.arange(48)) % cfg.vocab_size,
+                         jnp.int32)
+    batch = {"tokens": tokens}
+    for i in range(30):
+        state, loss = step(state, batch)
+        if i % 10 == 0:
+            print(f"step {i:3d} loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f} (progressions memorized)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Legacy Module API end-to-end (reference example/module/):
+symbol -> Module.fit with DataIter, metric, checkpoint callback.
+Run: python example/module/train_module.py
+"""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), '..', '..'))  # repo-root import
+import os
+import tempfile
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import io as mio
+from mxtpu import module, sym
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n, d, k = 800, 10, 3
+    centers = rng.randn(k, d) * 3
+    labels = rng.randint(0, k, n)
+    X = (centers[labels] + rng.randn(n, d)).astype(np.float32)
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=k, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    train_iter = mio.NDArrayIter(X, labels.astype(np.float32),
+                                 batch_size=64, shuffle=True)
+    mod = module.Module(net, context=mx.cpu())
+    prefix = os.path.join(tempfile.mkdtemp(), "mlp")
+    mod.fit(train_iter, num_epoch=8,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            eval_metric="acc",
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    score = mod.score(mio.NDArrayIter(X, labels.astype(np.float32),
+                                      batch_size=64), "acc")
+    print("final accuracy:", dict(score)["accuracy"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Pipeline parallelism demo: llama-tiny layer stack over a pp=2 mesh
+(GPipe microbatch schedule). Needs >=2 devices: run under
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+or on a TPU slice. Run: python example/pipeline_parallel/gpipe_demo.py
+"""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), '..', '..'))  # repo-root import
+import jax
+import jax.numpy as jnp
+import numpy as np
+from dataclasses import replace
+
+from mxtpu.models import llama
+from mxtpu.parallel import mesh as pmesh
+from mxtpu.parallel.pipeline import gpipe
+
+
+def main():
+    if len(jax.devices()) < 2:
+        print("need >= 2 devices for pp=2; set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        return
+    cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                  attn_impl="dense", remat=False, n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, cfg.dim))
+    cos, sin = llama.rope_tables(cfg, 32)
+
+    def layer_fn(lp, xx):
+        return llama._layer(cfg, None, cos, sin, xx, lp)
+
+    mesh = pmesh.create_mesh(dp=1, pp=2, devices=jax.devices()[:2])
+    out = jax.jit(lambda lp, xx: gpipe(layer_fn, lp, xx, mesh=mesh,
+                                       n_microbatches=4))(
+        params["layers"], x)
+    print("pipelined output:", out.shape,
+          "finite:", bool(jnp.isfinite(out).all()))
+
+
+if __name__ == "__main__":
+    main()

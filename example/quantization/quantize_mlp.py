@@ -1,0 +1,49 @@
+"""INT8 quantization demo (reference example/quantization/): train a
+small MLP in f32, quantize with entropy calibration, compare accuracy.
+Run: python example/quantization/quantize_mlp.py
+"""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), '..', '..'))  # repo-root import
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu import io as mio
+from mxtpu.contrib import quantization as quant
+from mxtpu.gluon import nn
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n, d, k = 1024, 16, 4
+    centers = rng.randn(k, d) * 3
+    labels = rng.randint(0, k, n)
+    X = (centers[labels] + rng.randn(n, d)).astype(np.float32)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(k))
+    net.initialize(init="xavier")
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    Xb, yb = mx.nd.array(X), mx.nd.array(labels.astype(np.float32))
+    for _ in range(80):
+        with autograd.record():
+            L = loss_fn(net(Xb), yb).mean()
+        L.backward()
+        trainer.step(n)
+
+    def acc(f):
+        out = f(Xb).asnumpy()
+        return (out.argmax(1) == labels).mean()
+
+    calib = mio.NDArrayIter(X[:256], None, batch_size=64)
+    qnet = quant.quantize_net(net, calib_data=calib,
+                              calib_mode="entropy")
+    print(f"f32 accuracy:  {acc(net):.3f}")
+    print(f"int8 accuracy: {acc(qnet):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -152,6 +152,8 @@ class Trainer:
         kv.pull_many(keys, [p.data() for _, p in live])
 
     def allreduce_grads(self) -> None:
+        if not self._kv_initialized:       # standalone use, before any
+            self._init_kvstore()           # step() (reference behavior)
         if self._kvstore is not None and hasattr(self._kvstore,
                                                  "allreduce_grads"):
             self._kvstore.allreduce_grads(self._params)

@@ -170,7 +170,32 @@ class DistKVStore(KVStore):
             live.append((g, src))
         if not live:
             return
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and comp is not None:
+            # the compressed wire: 2-bit packed bytes cross processes
+            # (16x fewer than f32 — the reference's actual ZMQ saving).
+            # ALL params concatenate into ONE packed buffer → a single
+            # allgather per step, then each worker unpacks + sums.
+            import numpy as _onp
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+            packs, metas = [], []
+            for g, src in live:
+                packed, n = comp.pack(src)
+                metas.append((packed.size, n, g.shape))
+                packs.append(packed)
+            buf = _onp.concatenate(packs)
+            gathered = multihost_utils.process_allgather(buf)  # (W, B)
+            reduced = []
+            off = 0
+            for nbytes, n, shape in metas:
+                total = None
+                for w in range(gathered.shape[0]):
+                    v = comp.unpack(gathered[w, off:off + nbytes], n,
+                                    shape)
+                    total = v if total is None else total + v
+                reduced.append(jnp.asarray(total))
+                off += nbytes
+        elif jax.process_count() > 1:
             reduced = self._allreduce_tree([s._data for _, s in live])
         else:
             reduced = [s._data for _, s in live]
@@ -309,9 +334,24 @@ class AsyncDistKVStore(DistKVStore):
 
     def push_many(self, keys, values) -> None:
         """Batched push: ONE message for all keys (vs the per-key RTT
-        of push) — the reference's multi-key ZPush."""
-        pairs = [(self._k(k), v.asnumpy()) for k, v in zip(keys, values)]
+        of push) — the reference's multi-key ZPush. Goes through
+        _local_aggregate so gradient compression (+ error-feedback
+        residuals) applies exactly like per-key push."""
+        pairs = [(self._k(k), self._local_aggregate(k, v).asnumpy())
+                 for k, v in zip(keys, values)]
         self._client.request("push_many", pairs)
+
+    def close(self) -> None:
+        """Drop this session's keys + optimizer on the server (a
+        long-lived process creating many stores would otherwise leak
+        every session's parameter copies in the rank-0 server)."""
+        try:
+            self._client.request("drop_ns", self._ns)
+        except Exception:
+            pass
+
+    def __del__(self):
+        self.close()
 
     def pull_many(self, keys, outs) -> None:
         """Batched pull: one message, preserving each out's placement."""

@@ -181,11 +181,23 @@ class KVStoreServer:
             old = self._updaters.get(ns)
             if old is not None and hasattr(old, "_optimizer"):
                 # hyperparameter refresh, not a restart: keep the
-                # schedule position (per-key update counts)
+                # schedule position AND the per-key optimizer state
+                # (Adam moments, momentum) — only the hyperparameters
+                # change
                 new._optimizer._index_update_count = dict(
                     old._optimizer._index_update_count)
                 new._optimizer.num_update = old._optimizer.num_update
+                new._updater.states = old._updater.states
+                new._updater.states_synced = old._updater.states_synced
             self._updaters[ns] = new
+            return ("ok",)
+        if op == "drop_ns":
+            _, ns = msg
+            with self._lock:
+                self._updaters.pop(ns, None)
+                for k in [k for k in self._store
+                          if isinstance(k, tuple) and k[0] == ns]:
+                    del self._store[k]
             return ("ok",)
         if op == "stop":
             self._running = False

@@ -48,6 +48,10 @@ def gpipe(layer_fn: Callable[[Any, Any], Any], stacked_params: Any, x,
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if L % S:
+        raise ValueError(
+            f"layer count {L} not divisible by {S} pipeline stages")
     mb = x.reshape((M, B // M) + x.shape[1:])
 
     param_specs = jax.tree.map(

