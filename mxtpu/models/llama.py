@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import flash_attention, dense_attention, ring_attention
+from ..ops.attention import (flash_attention, dense_attention,
+                             ring_attention, ulysses_attention)
 from ..parallel.sharding import ShardingRules, constrain
 
 __all__ = ["LlamaConfig", "init_params", "forward", "loss_fn",
@@ -54,7 +55,7 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32
-    attn_impl: str = "flash"         # flash | dense | ring
+    attn_impl: str = "flash"         # flash | dense | ring | ulysses
     remat: bool = True
     # None = full per-layer remat; "dots_no_batch" saves weight-matmul
     # outputs and recomputes only elementwise/attention in the backward
@@ -179,10 +180,19 @@ def apply_rope(x, cos, sin):
 
 
 def _attention(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
-    if cfg.attn_impl == "ring" and mesh is not None and "sp" in mesh.axis_names:
+    sp_ok = mesh is not None and "sp" in mesh.axis_names
+    if cfg.attn_impl in ("ring", "ulysses") and not sp_ok:
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} needs a mesh with an 'sp' "
+            "axis (got mesh="
+            f"{None if mesh is None else mesh.axis_names}); pass "
+            "mesh= to forward/loss_fn or use 'flash'")
+    if cfg.attn_impl in ("ring", "ulysses") and sp_ok:
         from jax.experimental.shard_map import shard_map
+        kernel = ring_attention if cfg.attn_impl == "ring" \
+            else ulysses_attention
         fn = shard_map(
-            partial(ring_attention, axis_name="sp", causal=True),
+            partial(kernel, axis_name="sp", causal=True),
             mesh=mesh, in_specs=(_QKV, _QKV, _QKV), out_specs=_QKV,
             check_rep=False)
         return fn(q, k, v)

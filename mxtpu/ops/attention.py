@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["dense_attention", "blockwise_attention", "flash_attention",
+           "ulysses_attention",
            "ring_attention"]
 
 _NEG_INF = -1e30  # finite "minus infinity": keeps fully-masked rows NaN-free
@@ -222,3 +223,56 @@ def ring_attention(q, k, v, *, axis_name: str = "sp",
 
     m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
     return _finalize(m, l, o, q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = False,
+                      scale: Optional[float] = None,
+                      kv_block: int = 512):
+    """Ulysses-style sequence parallelism (DeepSpeed-Ulysses; SURVEY
+    §5.7(c)): two all-to-alls reshard sequence-sharded QKV into
+    head-sharded full-sequence tensors, attention runs locally over the
+    FULL sequence for this device's head subset, and a final all-to-all
+    restores the sequence sharding.
+
+    Call INSIDE ``shard_map`` with q/k/v holding this device's sequence
+    shard, shapes (b, h, s/n, d). Heads must divide by the axis size.
+    vs ring attention: 4 all-to-alls (q, k, v, out) instead of n KV
+    rotations — wins when heads ≥ devices and seq is very long. KV
+    cross the wire UN-repeated (GQA head count) whenever the kv-head
+    count divides the axis, so grouped-query models pay kv-sized, not
+    q-sized, K/V collectives.
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    h = q.shape[1]
+    h_kv = k.shape[1]
+    if h % n:
+        raise ValueError(f"{h} heads not divisible over {n} '"
+                         f"{axis_name}' devices (Ulysses reshard)")
+
+    def seq_to_heads(x):
+        # (b, h, s/n, d) → (b, h/n, s, d)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    if h_kv % n == 0:
+        # reshard the GQA-sized KV, repeat locally AFTER the collective
+        qh = seq_to_heads(q)
+        kh = seq_to_heads(k)
+        vh = seq_to_heads(v)
+        kh, vh = _repeat_kv(qh, kh, vh)
+    else:
+        k, v = _repeat_kv(q, k, v)
+        qh = seq_to_heads(q)
+        kh = seq_to_heads(k)
+        vh = seq_to_heads(v)
+    # full sequence present locally → plain causal masking works; use
+    # the blockwise kernel (O(seq) memory) over the local head subset
+    oh = blockwise_attention(qh, kh, vh, causal=causal, scale=scale,
+                             kv_block=kv_block)
+    return heads_to_seq(oh)

@@ -228,3 +228,21 @@ def test_llama_fsdp_matches_unsharded(tiny_cfg):
     assert mu_leaves, "adam mu/nu for wq not found in opt_state"
     for m in mu_leaves:
         assert m.sharding.shard_shape(m.shape)[1] == wq.shape[1] // 2
+
+
+def test_llama_ulysses_matches_dense(tiny_cfg):
+    """Ulysses all-to-all sequence parallelism over sp=2 must match
+    dense attention globally (same check_consistency pattern as ring)."""
+    mesh = pmesh.create_mesh(dp=1, sp=2, tp=2,
+                             devices=jax.devices()[:4])
+    cfg_d = replace(tiny_cfg, dtype=jnp.float32, attn_impl="dense",
+                    remat=False)
+    cfg_u = replace(cfg_d, attn_impl="ulysses")
+    params = llama.init_params(cfg_d, jax.random.PRNGKey(4))
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 64), 0,
+                                cfg_d.vocab_size)
+    dense = llama.forward(cfg_d, params, tokens)
+    uly = jax.jit(lambda p, t: llama.forward(cfg_u, p, t, mesh=mesh))(
+        params, tokens)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(uly),
+                               rtol=1e-4, atol=1e-4)
