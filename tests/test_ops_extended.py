@@ -457,3 +457,128 @@ def test_upsampling_multi_input_common_size():
     assert out.shape == (1, 2, 8, 8)
     assert_almost_equal(out.asnumpy()[:, 1:2],
                         b.repeat(4, axis=2).repeat(4, axis=3))
+
+
+# -- tranche 2: random/sample, optimizer updates, im2col, masked -----------
+def test_flat_random_ops():
+    mx.nd.random.seed(3)
+    u = nd.random_uniform(low=2.0, high=3.0, shape=(100,))
+    assert u.shape == (100,)
+    assert 2.0 <= float(u.asnumpy().min()) and \
+        float(u.asnumpy().max()) <= 3.0
+    s = nd.sample_uniform(mx.nd.array([0.0, 10.0]),
+                          mx.nd.array([1.0, 20.0]), shape=50)
+    assert s.shape == (2, 50)
+    sn = s.asnumpy()
+    assert sn[0].max() <= 1.0 and 10.0 <= sn[1].min() <= sn[1].max() <= 20.0
+    nrm = nd.sample_normal(mx.nd.array([0.0, 100.0]),
+                           mx.nd.array([1.0, 1.0]), shape=200)
+    mu = nrm.asnumpy().mean(axis=1)
+    assert abs(mu[0]) < 0.5 and abs(mu[1] - 100) < 0.5
+    mnl = nd.sample_multinomial(mx.nd.array([0.0, 0.0, 1.0]), shape=8)
+    onp.testing.assert_array_equal(mnl.asnumpy(), 2 * onp.ones(8))
+    sh = nd.shuffle(mx.nd.array(onp.arange(10, dtype=onp.float32)))
+    assert sorted(sh.asnumpy().tolist()) == list(range(10))
+
+
+def test_optimizer_update_ops_vs_numpy():
+    w = mx.nd.array(onp.ones(4, onp.float32))
+    g = mx.nd.array(onp.full(4, 2.0, onp.float32))
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.01)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                1 - 0.1 * (2 + 0.01), rtol=1e-6)
+    mom = mx.nd.zeros((4,))
+    out = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    onp.testing.assert_allclose(out.asnumpy(), 1 - 0.2, rtol=1e-6)
+    onp.testing.assert_allclose(mom.asnumpy(), -0.2, rtol=1e-6)
+    # second step uses the mutated momentum buffer
+    out2 = nd.sgd_mom_update(out, g, mom, lr=0.1, momentum=0.9)
+    onp.testing.assert_allclose(mom.asnumpy(), 0.9 * -0.2 - 0.2,
+                                rtol=1e-6)
+
+    m, v = mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    out = nd.adam_update(w, g, m, v, lr=0.1)
+    ref_m = 0.1 * 2.0
+    ref_v = 0.001 * 4.0
+    onp.testing.assert_allclose(m.asnumpy(), ref_m, rtol=1e-5)
+    onp.testing.assert_allclose(v.asnumpy(), ref_v, rtol=1e-5)
+    onp.testing.assert_allclose(
+        out.asnumpy(), 1 - 0.1 * ref_m / (onp.sqrt(ref_v) + 1e-8),
+        rtol=1e-5)
+    out = nd.signsgd_update(w, g, lr=0.1)
+    onp.testing.assert_allclose(out.asnumpy(), 1 - 0.1, rtol=1e-6)
+    # multi-precision: bf16 weight, f32 master
+    w16 = mx.nd.array(onp.ones(4, onp.float32)).astype("bfloat16")
+    w32 = mx.nd.array(onp.ones(4, onp.float32))
+    out = nd.mp_sgd_update(w16, g.astype("bfloat16"), w32, lr=0.1)
+    onp.testing.assert_allclose(w32.asnumpy(), 0.8, rtol=1e-6)
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_all_finite_ops():
+    assert float(nd.all_finite(mx.nd.ones((3,))).asnumpy()[0]) == 1.0
+    assert float(nd.all_finite(
+        mx.nd.array([1.0, onp.inf])).asnumpy()[0]) == 0.0
+    r = nd.multi_all_finite(mx.nd.ones((2,)),
+                            mx.nd.array([onp.nan]), num_arrays=2)
+    assert float(r.asnumpy()[0]) == 0.0
+
+
+def test_im2col_col2im_round_trip():
+    x = randn(2, 3, 6, 6)
+    cols = nd.im2col(mx.nd.array(x), kernel=(3, 3), pad=(1, 1))
+    assert cols.shape == (2, 27, 36)
+    # col2im(im2col(x)) counts each pixel once per window covering it
+    back = nd.col2im(cols, output_size=(6, 6), kernel=(3, 3),
+                     pad=(1, 1))
+    counts = nd.col2im(nd.im2col(mx.nd.ones((2, 3, 6, 6)),
+                                 kernel=(3, 3), pad=(1, 1)),
+                       output_size=(6, 6), kernel=(3, 3), pad=(1, 1))
+    assert_almost_equal(back.asnumpy() / counts.asnumpy(), x, rtol=1e-5)
+
+
+def test_masked_softmax():
+    x = randn(2, 5)
+    m = onp.array([[1, 1, 0, 1, 0], [1, 1, 1, 1, 1]], onp.int32)
+    out = nd.masked_softmax(mx.nd.array(x), mx.nd.array(m)).asnumpy()
+    assert out[0, 2] == 0.0 and out[0, 4] == 0.0
+    onp.testing.assert_allclose(out.sum(-1), onp.ones(2), rtol=1e-5)
+    sub = x[0, [0, 1, 3]]
+    ref = onp.exp(sub - sub.max())
+    ref /= ref.sum()
+    onp.testing.assert_allclose(out[0, [0, 1, 3]], ref, rtol=1e-5)
+
+
+def test_linalg_gelqf():
+    a = randn(3, 5)
+    L, Q = nd.linalg_gelqf(mx.nd.array(a))
+    Ln, Qn = L.asnumpy(), Q.asnumpy()
+    assert_almost_equal(Ln @ Qn, a, rtol=1e-5)
+    assert_almost_equal(Qn @ Qn.T, onp.eye(3), rtol=1e-5, atol=1e-6)
+    # L lower-triangular
+    assert abs(onp.triu(Ln, 1)).max() < 1e-5
+
+
+def test_misc_tranche2():
+    x = randn(4, 4)
+    assert_almost_equal(nd.trace(mx.nd.array(x)), onp.trace(x))
+    u = nd.unique(mx.nd.array(onp.array([3.0, 1.0, 3.0, 2.0])))
+    onp.testing.assert_array_equal(u.asnumpy(), [1, 2, 3])
+    l = mx.nd.zeros((3, 4))
+    filled = nd.fill_element_0index(
+        l, mx.nd.array([9.0, 8.0, 7.0]), mx.nd.array([0.0, 2.0, 3.0]))
+    fn = filled.asnumpy()
+    assert fn[0, 0] == 9 and fn[1, 2] == 8 and fn[2, 3] == 7
+    s = nd.scatter_set_nd(mx.nd.zeros((2, 3)), mx.nd.array([5.0, 6.0]),
+                          mx.nd.array(onp.array([[0, 1], [1, 2]])))
+    assert s.asnumpy()[0, 1] == 5 and s.asnumpy()[1, 2] == 6
+    ident = nd.IdentityAttachKLSparseReg(mx.nd.array(x))
+    assert_almost_equal(ident, x)
+    # v1 aliases resolve
+    from mxtpu.ndarray.ops import OP_REGISTRY
+    assert OP_REGISTRY["Convolution_v1"] is OP_REGISTRY["Convolution"]
+
+
+def test_registry_count_tranche2():
+    from mxtpu.ndarray.ops import OP_REGISTRY
+    assert len(OP_REGISTRY) >= 325, len(OP_REGISTRY)

@@ -362,3 +362,39 @@ def test_example_scripts_smoke():
                  "PYTHONPATH": REPO + os.pathsep +
                  os.environ.get("PYTHONPATH", "")})
         assert out.returncode == 0, (script, out.stderr[-1200:])
+
+
+def test_launch_sge_emits_script(tmp_path):
+    """The SGE tracker writes a qsub array-job script with the DMLC
+    env protocol (reference dmlc_tracker/sge.py)."""
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "4", "--launcher", "sge",
+         "--env", "FOO=1", "--", "python", "train.py"],
+        capture_output=True, text=True, timeout=60, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr
+    script = (tmp_path / "mxtpu_sge_job.sh").read_text()
+    assert "#$ -t 1-4" in script
+    assert "DMLC_NUM_WORKER=4" in script
+    assert "DMLC_WORKER_ID=$((SGE_TASK_ID - 1))" in script
+    assert "export FOO=1" in script
+    assert "python train.py" in script
+
+
+def test_launch_mpi_rank_wrapper():
+    """The SHIPPED mpi wrapper (tools.launch._dmlc_wrapper) derives
+    DMLC_WORKER_ID from the MPI rank env and quotes env values."""
+    import argparse
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import launch as launch_mod
+    args = argparse.Namespace(num_workers=2,
+                              env=["EXTRA_ARGS=--foo bar"])
+    wrapper = launch_mod._dmlc_wrapper(
+        "${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}", args, "10.0.0.1",
+        9091)
+    out = subprocess.run(
+        ["bash", "-c", wrapper, "--", "bash", "-c",
+         'echo "$DMLC_WORKER_ID $EXTRA_ARGS"'],
+        capture_output=True, text=True, timeout=30,
+        env={**os.environ, "OMPI_COMM_WORLD_RANK": "3"})
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "3 --foo bar"

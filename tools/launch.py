@@ -99,13 +99,86 @@ def launch_ssh(args, command):
     return code
 
 
+def _dmlc_wrapper(rank_expr, args, coord, port):
+    """The bash prologue exporting the DMLC env protocol with the
+    worker id taken from ``rank_expr`` (scheduler-specific env var).
+    Shared by mpi/slurm so the tested code IS the shipped code; all
+    values are shell-quoted."""
+    import shlex
+    exports = [
+        "export DMLC_ROLE=worker",
+        f"export DMLC_PS_ROOT_URI={shlex.quote(str(coord))}",
+        f"export DMLC_PS_ROOT_PORT={shlex.quote(str(port))}",
+        f"export DMLC_NUM_WORKER={args.num_workers}",
+        f"export DMLC_WORKER_ID={rank_expr}",
+    ]
+    for e in (args.env or []):
+        k, _, v = e.partition("=")
+        exports.append(f"export {k}={shlex.quote(v)}")
+    return "; ".join(exports) + '; exec "$@"'
+
+
+def launch_mpi(args, command):
+    """mpirun-backed launch (reference dmlc_tracker/mpi.py): one rank
+    per worker; DMLC_* derived from OMPI/PMI rank vars by a wrapper."""
+    port = args.port or 9091
+    coord = os.environ.get("MXTPU_COORD_HOST", "127.0.0.1")
+    wrapper = _dmlc_wrapper(
+        "${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}", args, coord, port)
+    cmd = ["mpirun", "-np", str(args.num_workers), "bash", "-c",
+           wrapper, "--"] + list(command)
+    return subprocess.call(cmd)
+
+
+def launch_slurm(args, command):
+    """srun-backed launch (reference dmlc_tracker/slurm.py)."""
+    port = args.port or 9091
+    coord = os.environ.get("MXTPU_COORD_HOST",
+                           os.environ.get("SLURM_LAUNCH_NODE_IPADDR",
+                                          "127.0.0.1"))
+    wrapper = _dmlc_wrapper("${SLURM_PROCID:-0}", args, coord, port)
+    cmd = ["srun", f"--ntasks={args.num_workers}", "bash", "-c",
+           wrapper, "--"] + list(command)
+    return subprocess.call(cmd)
+
+
+def launch_sge(args, command):
+    """SGE array-job launch (reference dmlc_tracker/sge.py): emits a
+    qsub script; DMLC_WORKER_ID = SGE_TASK_ID - 1."""
+    port = args.port or 9091
+    coord = os.environ.get("MXTPU_COORD_HOST", "127.0.0.1")
+    import shlex
+    env_lines = []
+    for e in (args.env or []):
+        k, _, v = e.partition("=")
+        env_lines.append(f"export {k}={shlex.quote(v)}")
+    script = "\n".join([
+        "#!/bin/bash",
+        f"#$ -t 1-{args.num_workers}",
+        "#$ -cwd",
+        "export DMLC_ROLE=worker",
+        f"export DMLC_PS_ROOT_URI={shlex.quote(str(coord))}",
+        f"export DMLC_PS_ROOT_PORT={port}",
+        f"export DMLC_NUM_WORKER={args.num_workers}",
+        "export DMLC_WORKER_ID=$((SGE_TASK_ID - 1))",
+    ] + env_lines +
+        [" ".join(shlex.quote(c) for c in command), ""])
+    path = os.path.abspath("mxtpu_sge_job.sh")
+    with open(path, "w") as f:
+        f.write(script)
+    print(f"wrote {path}; submit with: qsub {path}")
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("-s", "--num-servers", type=int, default=0,
                    help="accepted for reference CLI parity (the "
                         "all-reduce design has no server role)")
-    p.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    p.add_argument("--launcher",
+               choices=["local", "ssh", "mpi", "slurm", "sge"],
+               default="local")
     p.add_argument("-H", "--host-file", help="hosts for --launcher ssh")
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--env", nargs="*", help="extra KEY=VALUE to export")
@@ -115,9 +188,10 @@ def main():
         args.command = args.command[1:]
     if not args.command:
         raise SystemExit("no command given")
-    if args.launcher == "local":
-        sys.exit(launch_local(args, args.command))
-    sys.exit(launch_ssh(args, args.command))
+    launchers = {"local": launch_local, "ssh": launch_ssh,
+                 "mpi": launch_mpi, "slurm": launch_slurm,
+                 "sge": launch_sge}
+    sys.exit(launchers[args.launcher](args, args.command))
 
 
 if __name__ == "__main__":
