@@ -17,6 +17,10 @@ def __getattr__(name):
             raise AttributeError(
                 "mxtpu.contrib.quantization is not available in this "
                 "build") from None
+    if name in ("deploy", "summary", "tensorboard"):
+        return importlib.import_module(
+            "mxtpu.contrib.summary" if name == "tensorboard"
+            else f"mxtpu.contrib.{name}")
     if name == "onnx":
         raise AttributeError(
             "ONNX import/export is not available in this build (no onnx "

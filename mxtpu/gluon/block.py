@@ -479,6 +479,41 @@ class HybridBlock(Block):
             params[kind + p.name] = p.data()
         nd.save(f"{path}-{epoch:04d}.params", params)
 
+    def export_stablehlo(self, path: str, *example_inputs):
+        """Serialize the inference forward as a portable StableHLO
+        artifact (weights baked in) — the TPU-native analogue of the
+        reference's ``net.export`` → C predict deploy path (SURVEY
+        §7.0: "net.export = StableHLO/orbax-export"). Reload anywhere
+        with ``mxtpu.contrib.deploy.load`` (no Python class needed) and
+        run on any jax backend. Shapes are fixed to the example
+        inputs'."""
+        from .. import autograd as _ag
+        ex = [x if isinstance(x, NDArray) else nd.array(x)
+              for x in example_inputs]
+        with _ag.pause(train_mode=False):
+            self(*ex)          # resolves deferred shapes if any
+        params = list(self.collect_params().values())
+        pvals = [p.data()._data for p in params]
+
+        def infer(*xs):
+            for p, v in zip(params, pvals):
+                p._bind_tracer(v)
+            try:
+                with _ag.pause(train_mode=False):
+                    out = self(*[NDArray(x) for x in xs])
+            finally:
+                for p in params:
+                    p._unbind_tracer()
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            return tuple(o._data for o in outs)
+
+        exp = jax.export.export(jax.jit(infer))(*[x._data for x in ex])
+        out_path = path if path.endswith(".stablehlo") else \
+            path + ".stablehlo"
+        with open(out_path, "wb") as f:
+            f.write(exp.serialize())
+        return out_path
+
 
 # ---------------------------------------------------------------------------
 # SymbolBlock
@@ -601,3 +636,4 @@ def _splice_symbol(symbol, input_map):
 
     entries = [(clone(n), i) for n, i in symbol._entries]
     return Symbol(entries)
+
