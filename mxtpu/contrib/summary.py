@@ -33,8 +33,11 @@ class SummaryWriter:
 
     def add_scalar(self, tag, value, global_step=None):
         v = _np(value).reshape(-1)
-        self._w.add_scalar(tag, float(v[0]) if v.size else 0.0,
-                           global_step)
+        if v.size != 1:
+            raise ValueError(
+                f"add_scalar needs a scalar, got shape {_np(value).shape}"
+                " — use add_histogram for vectors")
+        self._w.add_scalar(tag, float(v[0]), global_step)
 
     def add_histogram(self, tag, values, global_step=None, bins="auto"):
         self._w.add_histogram(tag, _np(values), global_step, bins=bins)

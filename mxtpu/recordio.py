@@ -20,8 +20,8 @@ import numpy as np
 
 from .base import MXNetError
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "RecordIOSplit",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
 
 _KMAGIC = 0xced7230a
 
@@ -287,3 +287,64 @@ def unpack_img(s: bytes, iscolor=-1):
     header, buf = unpack(s)
     return header, imdecode(buf, flag=0 if iscolor == 0 else 1,
                             to_rgb=True, as_numpy=True)
+
+
+# ---------------------------------------------------------------------------
+# InputSplit (reference 3rdparty/dmlc-core input_split.cc +
+# recordio_split.cc): partition one .rec file into byte ranges, each
+# part boundary-scanning forward to the next aligned record header —
+# the mechanism dist workers use to shard a dataset file without an
+# index.
+# ---------------------------------------------------------------------------
+def _scan_to_record(f, start: int, file_size: int) -> int:
+    """First aligned kMagic header at or after ``start`` that parses as
+    a plausible record START (cflag 0 = whole record or 1 = first
+    chunk). Continuation chunks (cflag 2/3) are skipped — that's the
+    reason the cflag exists: a split boundary landing inside a
+    multi-part record must not start a part mid-record."""
+    pos = start + ((-start) % 4)
+    f.seek(pos)
+    while pos + 8 <= file_size:
+        hdr = f.read(8)
+        if len(hdr) < 8:
+            return file_size
+        magic, lrec = struct.unpack("<II", hdr)
+        cflag = lrec >> 29
+        length = lrec & ((1 << 29) - 1)
+        if magic == _KMAGIC and cflag in (0, 1) and \
+                pos + 8 + length <= file_size:
+            return pos
+        pos += 4
+        f.seek(pos)
+    return file_size
+
+
+class RecordIOSplit:
+    """Iterate the records of ONE part of an evenly byte-partitioned
+    RecordIO file (reference dmlc ``InputSplit::Create(uri, part,
+    nsplit, "recordio")``). A record belongs to the part its header
+    byte falls in, so every record is yielded by exactly one part."""
+
+    def __init__(self, uri: str, part: int, num_parts: int):
+        if not 0 <= part < num_parts:
+            raise ValueError(f"part {part} not in [0, {num_parts})")
+        self.uri = uri
+        size = os.path.getsize(uri)
+        lo = part * size // num_parts
+        hi = (part + 1) * size // num_parts
+        self._reader = MXRecordIO(uri, "r")
+        f = self._reader.record
+        self._start = _scan_to_record(f, lo, size) if lo else 0
+        self._end = _scan_to_record(f, hi, size) if hi < size else size
+        self._reader.seek(self._start)
+
+    def __iter__(self):
+        self._reader.seek(self._start)
+        while self._reader.tell() < self._end:
+            rec = self._reader.read()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self):
+        self._reader.close()

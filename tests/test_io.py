@@ -390,3 +390,54 @@ def test_ndarrayiter_roll_over_getindex_matches_data():
     idx = it.getindex()
     onp.testing.assert_array_equal(
         batch.data[0].asnumpy().ravel(), data[idx].ravel())
+
+
+def test_recordio_split_partitions_exactly():
+    """dmlc InputSplit semantics: N parts of one .rec cover every
+    record exactly once, wherever the byte boundaries fall — including
+    through multi-part (escaped-magic) records."""
+    import tempfile
+    magic = struct.pack("<I", 0xced7230a)
+    path = os.path.join(tempfile.mkdtemp(), "split.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = []
+    rng = onp.random.default_rng(0)
+    for i in range(57):
+        body = bytes(rng.integers(0, 256, int(rng.integers(5, 200)),
+                                  dtype=onp.uint8))
+        if i % 9 == 0:
+            body = body[:4] + magic + body[4:]   # escaped multi-part
+        payloads.append(body)
+        w.write(body)
+    w.close()
+    for nparts in (1, 2, 3, 5):
+        got = []
+        for part in range(nparts):
+            sp = recordio.RecordIOSplit(path, part, nparts)
+            got.extend(sp)
+            sp.close()
+        assert got == payloads, f"nparts={nparts}: wrong partition"
+
+
+def test_recordio_split_boundary_inside_multipart():
+    """A split boundary landing INSIDE a multi-part record must not
+    start a part at a continuation chunk (cflag 2/3 are skipped)."""
+    import tempfile
+    magic = struct.pack("<I", 0xced7230a)
+    path = os.path.join(tempfile.mkdtemp(), "mp_split.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = []
+    for i in range(6):
+        # large payloads stuffed with aligned magics → many chunks, so
+        # most byte offsets fall inside multi-part records
+        body = (b"abcd" + magic) * 200 + bytes([i]) * 5
+        payloads.append(body)
+        w.write(body)
+    w.close()
+    for nparts in (2, 4, 7):
+        got = []
+        for part in range(nparts):
+            sp = recordio.RecordIOSplit(path, part, nparts)
+            got.extend(sp)
+            sp.close()
+        assert got == payloads, f"nparts={nparts}"
