@@ -15,3 +15,7 @@ from .image import (imdecode, imencode, imread, imresize, resize_short,
                     CastAug, ColorNormalizeAug, BrightnessJitterAug,
                     ContrastJitterAug, SaturationJitterAug, ColorJitterAug,
                     LightingAug, RandomSizedCropAug, ImageIter)
+from .detection import (DetAugmenter, DetBorrowAug,         # noqa: F401
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateDetAugmenter,
+                        ImageDetIter)

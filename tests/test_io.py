@@ -441,3 +441,87 @@ def test_recordio_split_boundary_inside_multipart():
             got.extend(sp)
             sp.close()
         assert got == payloads, f"nparts={nparts}"
+
+
+def _make_det_rec(tmp_path, n=12):
+    """A tiny detection RecordIO set: synthetic images + packed det
+    labels [2, 5, (cls, x1, y1, x2, y2)*N]."""
+    rng = onp.random.default_rng(0)
+    path = str(tmp_path / "det.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "det.idx"), path, "w")
+    truth = []
+    for i in range(n):
+        img = (rng.random((32, 40, 3)) * 255).astype(onp.uint8)
+        n_obj = int(rng.integers(1, 4))
+        boxes = []
+        for _ in range(n_obj):
+            x1, y1 = rng.random(2) * 0.5
+            boxes.append([float(rng.integers(0, 3)), x1, y1,
+                          x1 + 0.3, y1 + 0.3])
+        label = [2.0, 5.0] + [v for b in boxes for v in b]
+        truth.append(onp.array(boxes, onp.float32))
+        hdr = recordio.IRHeader(0, onp.array(label, onp.float32), i, 0)
+        w.write_idx(i, recordio.pack_img(hdr, img, quality=95))
+    w.close()
+    return path, truth
+
+
+def test_image_det_iter(tmp_path):
+    from mxtpu.image import ImageDetIter
+    path, truth = _make_det_rec(tmp_path)
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                      path_imgrec=path)
+    assert it.provide_label[0].shape[1] == max(t.shape[0] for t in truth)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape[2] == 5
+    # first image's boxes survive un-augmented iteration exactly
+    valid = lab[0][lab[0, :, 0] >= 0]
+    onp.testing.assert_allclose(valid, truth[0], rtol=1e-5, atol=1e-6)
+    n_batches = 1 + sum(1 for _ in it)
+    assert n_batches == 3
+
+
+def test_det_augmenters_move_boxes_consistently():
+    from mxtpu.image import (DetHorizontalFlipAug, DetRandomPadAug,
+                             DetRandomCropAug)
+    rng = onp.random.default_rng(1)
+    img = (rng.random((40, 60, 3)) * 255).astype(onp.float32)
+    label = onp.array([[1.0, 0.25, 0.25, 0.5, 0.5]], onp.float32)
+
+    flip = DetHorizontalFlipAug(p=1.0)
+    img2, lab2 = flip(img, label.copy())
+    onp.testing.assert_allclose(lab2[0, [1, 3]], [0.5, 0.75], rtol=1e-6)
+    onp.testing.assert_allclose(img2[:, 0], img[:, -1])
+
+    onp.random.seed(0)
+    pad = DetRandomPadAug(area_range=(2.0, 2.0),
+                          aspect_ratio_range=(1.0, 1.0))
+    img3, lab3 = pad(img, label.copy())
+    assert img3.shape[0] >= img.shape[0] and img3.shape[1] >= img.shape[1]
+    w3 = lab3[0, 3] - lab3[0, 1]
+    assert w3 < 0.25 + 1e-6   # box shrinks on the bigger canvas
+
+    onp.random.seed(1)
+    crop = DetRandomCropAug(min_object_covered=0.9,
+                            area_range=(0.5, 0.9))
+    img4, lab4 = crop(img, label.copy())
+    v = lab4[lab4[:, 0] >= 0]
+    if len(v):   # crop found: box stays normalized and ordered
+        assert (v[:, 1] <= v[:, 3]).all() and (v[:, 2] <= v[:, 4]).all()
+        assert v.min() >= -1e-6 and v[:, 1:].max() <= 1 + 1e-6
+
+
+def test_det_augmenter_list_has_no_geometric_borrows():
+    """A borrowed crop would move pixels without moving boxes — the
+    silent-corruption class the det pipeline exists to avoid."""
+    from mxtpu.image import CreateDetAugmenter, DetBorrowAug
+    from mxtpu.image.image import (CenterCropAug, RandomCropAug,
+                                   RandomSizedCropAug)
+    augs = CreateDetAugmenter((3, 32, 32), rand_mirror=True)
+    for a in augs:
+        if isinstance(a, DetBorrowAug):
+            assert not isinstance(a.augmenter, (CenterCropAug,
+                                                RandomCropAug,
+                                                RandomSizedCropAug)), a
