@@ -17,6 +17,8 @@ def __getattr__(name):
             raise AttributeError(
                 "mxtpu.contrib.quantization is not available in this "
                 "build") from None
+    if name == "text":
+        return importlib.import_module("mxtpu.contrib.text")
     if name in ("deploy", "summary", "tensorboard"):
         return importlib.import_module(
             "mxtpu.contrib.summary" if name == "tensorboard"

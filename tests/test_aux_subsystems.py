@@ -298,3 +298,61 @@ def test_summary_writer(tmp_path):
         sw.add_text("note", "hello", 1)
     events = list(tmp_path.glob("events.out.tfevents.*"))
     assert events and events[0].stat().st_size > 0
+
+
+def test_contrib_text_vocab_embedding(tmp_path):
+    """mx.contrib.text: vocabulary + embedding container feeding
+    nn.Embedding (reference contrib/text)."""
+    from mxtpu.contrib import text as mtext
+    counter = mtext.count_tokens_from_str(
+        "the cat sat on the mat the cat", to_lower=True)
+    vocab = mtext.Vocabulary(counter, min_freq=2,
+                             reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then by freq desc: the(3), cat(2)
+    assert vocab.idx_to_token[:4] == ["<unk>", "<pad>", "the", "cat"]
+    assert vocab.to_indices(["the", "dog"]) == [2, 0]
+    assert vocab.to_tokens(3) == "cat"
+
+    fp = tmp_path / "emb.txt"
+    fp.write_text("the 1.0 0.0\ncat 0.0 1.0\nmat 0.5 0.5\n")
+    emb = mtext.CustomEmbedding(str(fp), vocabulary=vocab)
+    assert emb.vec_len == 2
+    mat = emb.idx_to_vec.asnumpy()
+    assert mat.shape == (len(vocab), 2)
+    onp.testing.assert_allclose(mat[2], [1.0, 0.0])
+    onp.testing.assert_allclose(mat[0], [0.0, 0.0])   # unk default
+    v = emb.get_vecs_by_tokens(["cat", "unknown"]).asnumpy()
+    onp.testing.assert_allclose(v, [[0.0, 1.0], [0.0, 0.0]])
+    emb.update_token_vectors("cat", mx.nd.array([[9.0, 9.0]]))
+    onp.testing.assert_allclose(emb.idx_to_vec.asnumpy()[3], [9.0, 9.0])
+
+    # feeds an actual Embedding layer
+    layer = nn.Embedding(len(vocab), 2)
+    layer.initialize()
+    layer.weight.set_data(emb.idx_to_vec)
+    out = layer(mx.nd.array(onp.array([2.0, 3.0])))
+    onp.testing.assert_allclose(out.asnumpy(), [[1, 0], [9, 9]],
+                                rtol=1e-6)
+
+
+def test_contrib_text_robust_parsing_and_oov_update(tmp_path):
+    from mxtpu.contrib import text as mtext
+    fp = tmp_path / "ft.vec"
+    # fastText header + a malformed line + doubled delimiter
+    fp.write_text("40000 2\nthe 1.0 0.0\n. . . 9 9\ncat  0.0 1.0\n")
+    vocab = mtext.Vocabulary(
+        mtext.count_tokens_from_str("the cat sat"))
+    emb = mtext.CustomEmbedding(str(fp), vocabulary=vocab)
+    assert emb.vec_len == 2
+    assert "40000" not in emb._table         # header skipped
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [0.0, 1.0])
+    # OOV-in-table but in-vocab token updates its idx row
+    emb.update_token_vectors("sat", mx.nd.array([[7.0, 7.0]]))
+    i = vocab.token_to_idx["sat"]
+    onp.testing.assert_allclose(emb.idx_to_vec.asnumpy()[i], [7, 7])
+    # width mismatch rejected before any mutation
+    with pytest.raises(Exception):
+        emb.update_token_vectors("cat", mx.nd.array([[1.0, 2.0, 3.0]]))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [0.0, 1.0])
