@@ -31,6 +31,9 @@ def main():
     p.add_argument("--dim", type=int, default=1000)
     p.add_argument("--epochs", type=int, default=12)
     p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--kvstore", default="local",
+                   help="local | dist_sync | dist_async (async = real "
+                        "parameter server, reference config-4 path)")
     args = p.parse_args()
     import mxtpu as mx
     from mxtpu import autograd
@@ -46,10 +49,12 @@ def main():
 
     # update_on_kvstore pattern (reference example): weights live in the
     # store, workers push grads, the store's optimizer applies them
-    kv = mx.kv.create("local")
+    kv = mx.kv.create(args.kvstore)
     w = mx.nd.zeros((args.dim, 1), ctx=mx.tpu())
     kv.init("w", w)
     kv.set_optimizer(mx.optimizer.SGD(learning_rate=3.0))
+    if kv.num_workers > 1:
+        print(f"worker {kv.rank}/{kv.num_workers} ({args.kvstore})")
     w.attach_grad()
     for epoch in range(args.epochs):
         it.reset()
@@ -67,8 +72,21 @@ def main():
             w.attach_grad()
             tot += float(loss.asscalar())
             n += 1
-        print(f"epoch {epoch}: loss {tot / n:.4f}")
+        print(f"epoch {epoch}: loss {tot / n:.4f}", flush=True)
     assert tot / n < 0.5
+    # the sparse PS path (reference row_sparse_pull): fetch ONLY the
+    # rows a batch touches — the full table never crosses the wire
+    from mxtpu.ndarray import sparse as msparse
+    it.reset()
+    batch = next(iter(it))
+    cols = np.unique(batch.data[0].asnumpy().nonzero()[1])[:32]
+    rs = msparse.row_sparse_array(
+        (np.zeros((1, 1), np.float32), [0]), shape=(args.dim, 1))
+    kv.row_sparse_pull("w", out=rs, row_ids=cols.tolist())
+    print(f"row_sparse_pull fetched {rs.indices.shape[0]} rows "
+          f"of {args.dim}")
+    if hasattr(kv, "barrier"):
+        kv.barrier()
     print("done")
 
 

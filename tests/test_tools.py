@@ -398,3 +398,22 @@ def test_launch_mpi_rank_wrapper():
         env={**os.environ, "OMPI_COMM_WORLD_RANK": "3"})
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "3 --foo bar"
+
+
+@pytest.mark.slow
+def test_sparse_linear_classification_dist_async(tmp_path):
+    """BASELINE config 4's distributed leg end-to-end: the sparse
+    linear-classification example converges on 2 workers over the
+    dist_async parameter server, with row-sparse pulls."""
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--env", "JAX_PLATFORMS=cpu", "MXTPU_PS_PORT_OFFSET=43", "--",
+         sys.executable,
+         os.path.join(REPO, "example", "sparse",
+                      "linear_classification.py"),
+         "--kvstore", "dist_async", "--epochs", "6", "--dim", "400"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    assert out.stdout.count("done") == 2
+    assert "row_sparse_pull fetched" in out.stdout
