@@ -56,17 +56,22 @@ multichip_dryrun() {
 }
 
 bench_smoke() {
-    # one tiny benchmark pass to prove bench.py still emits its JSON
-    # line (full numbers are the driver's job, on the real chip)
-    JAX_PLATFORMS=cpu python - << 'PYEOF'
-import jax
-jax.config.update("jax_platforms", "cpu")
-import subprocess, sys, json
-# importing bench compiles nothing; exercise the CLI arg validation
+    # run ONE real (tiny) bench step on CPU so jit/shape regressions in
+    # the bench path fail CI; also keep the CLI-rejection contract.
+    # Full numbers are the driver's job, on the real chip.
+    python - << 'PYEOF'
+import json, os, subprocess, sys
+env = dict(os.environ, JAX_PLATFORMS="cpu")
 out = subprocess.run([sys.executable, "bench.py", "bogus"],
-                     capture_output=True, text=True)
+                     capture_output=True, text=True, env=env)
 assert out.returncode != 0, "bench.py must reject unknown configs"
-print("bench_smoke: OK (CLI contract)")
+out = subprocess.run([sys.executable, "bench.py", "smoke"],
+                     capture_output=True, text=True, env=env)
+assert out.returncode == 0, f"smoke bench failed:\n{out.stderr[-2000:]}"
+line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+rec = json.loads(line)
+assert rec["value"] > 0, rec
+print(f"bench_smoke: OK ({rec['metric']}={rec['value']} {rec['unit']})")
 PYEOF
 }
 

@@ -50,7 +50,8 @@ def main():
     # update_on_kvstore pattern (reference example): weights live in the
     # store, workers push grads, the store's optimizer applies them
     kv = mx.kv.create(args.kvstore)
-    w = mx.nd.zeros((args.dim, 1), ctx=mx.tpu())
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    w = mx.nd.zeros((args.dim, 1), ctx=ctx)
     kv.init("w", w)
     kv.set_optimizer(mx.optimizer.SGD(learning_rate=3.0))
     if kv.num_workers > 1:
@@ -60,8 +61,8 @@ def main():
         it.reset()
         tot, n = 0.0, 0
         for batch in it:
-            x = batch.data[0].as_in_context(mx.tpu())
-            y = batch.label[0].as_in_context(mx.tpu()).reshape(-1, 1)
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].as_in_context(ctx).reshape(-1, 1)
             with autograd.record():
                 z = mx.nd.dot(x, w).sigmoid()
                 loss = -(y * (z + 1e-7).log() +

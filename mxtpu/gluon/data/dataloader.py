@@ -8,17 +8,26 @@ Worker model, mirroring the reference:
 - ``num_workers > 0, thread_pool=True`` — threaded prefetch pipeline.
   On this 1-core box (and generally under PJRT, where the device owns
   transfers) this is the recommended fast path.
-- ``num_workers > 0, thread_pool=False`` — REAL forked worker
-  processes (the reference's multiprocessing pool + shared-memory
-  NDArray IPC). Workers batchify with ``default_mp_batchify_fn``
-  (numpy — forked children must not touch the PJRT device) and ship
-  batches back to the parent, which converts to NDArray. Datasets must
-  yield numpy-convertible samples on this path; use ``thread_pool``
-  for datasets whose transforms need device ops.
+- ``num_workers > 0, thread_pool=False`` — REAL worker processes (the
+  reference's multiprocessing pool + shared-memory NDArray IPC).
+  Workers batchify with ``default_mp_batchify_fn`` (numpy — worker
+  children must not touch the PJRT device) and ship batches back to
+  the parent, which converts to NDArray. Datasets must yield
+  numpy-convertible samples on this path; use ``thread_pool`` for
+  datasets whose transforms need device ops.
+
+  Workers start via the ``forkserver`` context by default: ``fork`` of
+  a JAX-initialized (multithreaded) parent can deadlock in the child
+  regardless of what the dataset holds, so the dataset + batchify_fn
+  are instead pickled to freshly-started workers. Set
+  ``MXTPU_MP_START_METHOD=fork`` to ride copy-on-write for huge
+  unpicklable datasets — at your own risk, and before JAX dispatches
+  work.
 """
 from __future__ import annotations
 
 import multiprocessing as _mp
+import os as _os
 import queue as _queue
 import threading
 from typing import Callable, List, Optional
@@ -71,6 +80,15 @@ _worker_batchify = None
 
 def _worker_init(dataset, batchify_fn):
     global _worker_dataset, _worker_batchify
+    # workers are numpy-only: pin any lazy jax init in this process to
+    # CPU so a worker can never dial the accelerator (the TPU tunnel
+    # admits ONE client; a second connect hangs the worker)
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     _worker_dataset = dataset
     _worker_batchify = batchify_fn
 
@@ -126,30 +144,58 @@ class DataLoader:
         return self._batchify_fn(samples)
 
     def _check_mp_safe(self):
-        """Probe ONE sample in the parent: device-backed samples would
-        make the forked child touch the PJRT client (deadlock risk on
-        TPU) — fail loudly with the fix instead."""
+        """Probe ONE sample in the parent: device-backed samples
+        (anywhere in a nested tuple/list/dict sample) would make the
+        worker child touch the PJRT client (deadlock risk on TPU) —
+        fail loudly with the fix instead."""
         import jax
         if len(self._dataset) == 0 or jax.default_backend() == "cpu":
             return
-        sample = self._dataset[0]
-        parts = sample if isinstance(sample, tuple) else (sample,)
-        if any(isinstance(x, NDArray) for x in parts):
+
+        def has_nd(x):
+            if isinstance(x, NDArray):
+                return True
+            if isinstance(x, (tuple, list)):
+                return any(has_nd(i) for i in x)
+            if isinstance(x, dict):
+                return any(has_nd(v) for v in x.values())
+            return False
+
+        if has_nd(self._dataset[0]):
             raise ValueError(
-                "DataLoader(num_workers>0) forks worker processes, but "
-                "this dataset yields device-backed NDArrays — forked "
+                "DataLoader(num_workers>0) runs worker processes, but "
+                "this dataset yields device-backed NDArrays — worker "
                 "children must not touch the TPU. Use thread_pool=True "
                 "or make the dataset/transforms yield numpy.")
 
     @property
     def _pool(self):
-        """Worker pool, forked once and reused across epochs (the
-        reference creates its pool in __init__)."""
+        """Worker pool, started once and reused across epochs (the
+        reference creates its pool in __init__). forkserver by default
+        (see module docstring); MXTPU_MP_START_METHOD overrides."""
         pool = getattr(self, "_pool_cache", None)
         if pool is None:
-            ctx = _mp.get_context("fork")
-            pool = ctx.Pool(self._num_workers, initializer=_worker_init,
-                            initargs=(self._dataset, self._batchify_fn))
+            method = _os.environ.get("MXTPU_MP_START_METHOD")
+            if not method:
+                method = ("forkserver"
+                          if "forkserver" in _mp.get_all_start_methods()
+                          else "fork")
+            ctx = _mp.get_context(method)
+            # children capture the env at process start: force CPU so
+            # neither the forkserver process nor a worker ever opens
+            # the accelerator client (see _worker_init)
+            old = _os.environ.get("JAX_PLATFORMS")
+            _os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                pool = ctx.Pool(self._num_workers,
+                                initializer=_worker_init,
+                                initargs=(self._dataset,
+                                          self._batchify_fn))
+            finally:
+                if old is None:
+                    _os.environ.pop("JAX_PLATFORMS", None)
+                else:
+                    _os.environ["JAX_PLATFORMS"] = old
             self._pool_cache = pool
         return pool
 

@@ -127,6 +127,33 @@ class Trainer:
             self._scale = self._amp_original_scale
             self._amp_unscaled = False
 
+    def _opt_fingerprint(self):
+        """Change signature over ALL live optimizer hyperparameters
+        (not just lr/rescale_grad): hash the pickled attribute dict
+        minus per-key update state, so any user mutation — wd,
+        momentum, clip_gradient, an lr-scheduler edit — reaches the
+        server-side optimizer on the next step (ADVICE r2)."""
+        import hashlib
+        import pickle as _pkl
+        # skip per-step update state AND Parameter-holding attrs:
+        # param_dict holds live Parameters (weight data mutates every
+        # step — including it would re-ship the optimizer each step);
+        # lr_mult/wd_mult per-param scaling IS covered via the
+        # lr_mult/wd_mult dicts themselves
+        skip = {"_index_update_count", "_all_index_update_counts",
+                "num_update", "param_dict"}
+        d = {k: v for k, v in vars(self._optimizer).items()
+             if k not in skip}
+        d["__param_mults"] = sorted(
+            (n, p.lr_mult, p.wd_mult)
+            for n, p in self._optimizer.param_dict.items())
+        try:
+            blob = _pkl.dumps(sorted(d.items()), protocol=4)
+        except Exception:    # unpicklable attr: fall back to the pair
+            return (self._optimizer.rescale_grad,
+                    self._optimizer.learning_rate)
+        return hashlib.sha1(blob).digest()
+
     def _step_on_kvstore(self) -> None:
         """Push grads / pull weights (reference Module/Trainer with
         update_on_kvstore: the server applies the optimizer the moment
@@ -136,7 +163,7 @@ class Trainer:
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null" and p._data is not None]
         keys = [i for i, _ in live]
-        hp = (self._optimizer.rescale_grad, self._optimizer.learning_rate)
+        hp = self._opt_fingerprint()
         if not getattr(self, "_kv_params_on_server", False):
             kv.init(keys, [p.data() for _, p in live])
             kv.set_optimizer(self._optimizer)
@@ -144,7 +171,8 @@ class Trainer:
             kv.pull_many(keys, [p.data() for _, p in live])
             self._kv_params_on_server = True
         elif getattr(self, "_kv_server_hp", None) != hp:
-            # rescale_grad (batch size / AMP scale) or lr changed since
+            # ANY live hyperparameter changed (lr, rescale_grad, wd,
+            # momentum, clip_gradient, scheduler mutation, ...) since
             # the server's optimizer copy was pickled — refresh it
             kv.set_optimizer(self._optimizer)
             self._kv_server_hp = hp

@@ -231,14 +231,25 @@ class AsyncDistKVStore(DistKVStore):
         self._server = None
         if self.rank == 0:
             try:
-                self._server = psrv.KVStoreServer(
-                    "0.0.0.0" if jax.process_count() > 1 else host, port)
-            except OSError:
+                # bind the coordinator interface only (never 0.0.0.0):
+                # the DMLC root URI is the address every worker dials,
+                # and narrowing the bind keeps foreign peers off the
+                # port (ADVICE r2; pair with MXTPU_PS_SECRET off-host)
+                self._server = psrv.KVStoreServer(host, port)
+            except OSError as e:
                 # port taken — usually a server from an earlier store in
                 # this process (reference: servers outlive worker-side
                 # KVStore handles). The ping below verifies it actually
                 # speaks this protocol; anything else errors out.
-                pass
+                # ONLY address-in-use falls through: EADDRNOTAVAIL (the
+                # root URI is a NAT/VIP address this host can't bind)
+                # must surface now, not as a connect-timeout later.
+                import errno
+                if e.errno != errno.EADDRINUSE:
+                    raise MXNetError(
+                        f"rank 0 cannot bind the kvstore server on "
+                        f"{host}:{port} ({e}); DMLC_PS_ROOT_URI must be "
+                        "an address rank 0 can bind locally") from e
         self._client = psrv.ServerClient(host, port)
         reply = self._client.request("ping")
         if len(reply) < 2 or reply[1] != "mxtpu-ps":

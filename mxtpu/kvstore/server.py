@@ -22,12 +22,30 @@ daemon thread and every rank (including 0) talks to it over
 localhost/DCN TCP. This keeps the reference's observable semantics
 with one process role.
 
-Wire format: length-prefixed pickles. The server is host-side numpy,
-like the reference's CPU-side server applying ``sgd_update`` on
-aggregated grads.
+Wire format: length-prefixed frames carrying a SAFE tag-based binary
+encoding (struct headers + raw numpy bytes) — NOT pickle, so a foreign
+peer can never achieve code execution by connecting to the port. The
+one legitimately-pickled payload (``set_optimizer``'s optimizer blob,
+matching the reference's ``_send_command_to_servers``) travels as
+opaque bytes and is only *unpickled* when the peer is trusted: the
+frame was HMAC-authenticated (``MXTPU_PS_SECRET``) or the server is
+bound to loopback. Set ``MXTPU_PS_SECRET`` (launch.py forwards it) to
+authenticate every frame with HMAC-SHA256 on multi-host runs.
+
+The HMAC guarantees frame INTEGRITY + peer authentication only — there
+is no nonce/sequence, so an on-path attacker can replay captured
+frames (async-PS pushes are idempotent-ish but replays still perturb
+training). Runs on untrusted networks should ride an encrypted
+transport (WireGuard/stunnel) underneath, as the reference's ps-lite
+deployments did.
+
+The server is host-side numpy, like the reference's CPU-side server
+applying ``sgd_update`` on aggregated grads.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 import os
 import pickle
 import socket
@@ -43,6 +61,9 @@ from ..base import MXNetError
 __all__ = ["KVStoreServer", "ServerClient", "server_address"]
 
 _LEN = struct.Struct("<Q")
+_I = struct.Struct("<q")
+_F = struct.Struct("<d")
+_U32 = struct.Struct("<I")
 
 
 def server_address() -> tuple:
@@ -54,15 +75,126 @@ def server_address() -> tuple:
     return host, port + int(os.environ.get("MXTPU_PS_PORT_OFFSET", "17"))
 
 
-def _send_msg(sock: socket.socket, obj: Any) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+def _wire_secret() -> bytes:
+    return os.environ.get("MXTPU_PS_SECRET", "").encode()
+
+
+# ---- safe codec: tags + struct headers + raw buffers (no pickle) ----
+_T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_FLOAT, _T_STR, _T_BYTES, \
+    _T_TUPLE, _T_LIST, _T_ARR = range(10)
+
+
+def _enc(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(_T_NONE)
+    elif obj is True:
+        out.append(_T_TRUE)
+    elif obj is False:
+        out.append(_T_FALSE)
+    elif isinstance(obj, (int, onp.integer)):
+        out.append(_T_INT)
+        out += _I.pack(int(obj))
+    elif isinstance(obj, (float, onp.floating)):
+        out.append(_T_FLOAT)
+        out += _F.pack(float(obj))
+    elif isinstance(obj, str):
+        b = obj.encode()
+        out.append(_T_STR)
+        out += _U32.pack(len(b)) + b
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(_T_BYTES)
+        out += _U32.pack(len(obj)) + obj
+    elif isinstance(obj, tuple):
+        out.append(_T_TUPLE)
+        out += _U32.pack(len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, list):
+        out.append(_T_LIST)
+        out += _U32.pack(len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, onp.ndarray):
+        a = onp.asarray(obj)    # tobytes() C-orders; NOT
+        # ascontiguousarray, which promotes 0-d to 1-d
+        if a.dtype.hasobject:
+            raise TypeError("object arrays are not wire-safe")
+        dt = a.dtype.str.encode()    # e.g. b'<f4'
+        out.append(_T_ARR)
+        out += _U32.pack(len(dt)) + dt
+        out += _U32.pack(a.ndim)
+        for d in a.shape:
+            out += _I.pack(d)
+        raw = a.tobytes()
+        out += _LEN.pack(len(raw)) + raw
+    else:
+        raise TypeError(f"type {type(obj).__name__} is not wire-safe")
+
+
+def _dec(buf: memoryview, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_INT:
+        return _I.unpack_from(buf, pos)[0], pos + 8
+    if tag == _T_FLOAT:
+        return _F.unpack_from(buf, pos)[0], pos + 8
+    if tag in (_T_STR, _T_BYTES):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        raw = bytes(buf[pos:pos + n])
+        return (raw.decode() if tag == _T_STR else raw), pos + n
+    if tag in (_T_TUPLE, _T_LIST):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            x, pos = _dec(buf, pos)
+            items.append(x)
+        return (tuple(items) if tag == _T_TUPLE else items), pos
+    if tag == _T_ARR:
+        (nd,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        dt = onp.dtype(bytes(buf[pos:pos + nd]).decode())
+        if dt.hasobject:
+            raise ConnectionError("object dtype on the wire")
+        pos += nd
+        (ndim,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        shape = []
+        for _ in range(ndim):
+            shape.append(_I.unpack_from(buf, pos)[0])
+            pos += 8
+        (nraw,) = _LEN.unpack_from(buf, pos)
+        pos += 8
+        a = onp.frombuffer(bytes(buf[pos:pos + nraw]),
+                           dtype=dt).reshape(shape)
+        return a, pos + nraw
+    raise ConnectionError(f"bad wire tag {tag} — foreign protocol")
 
 
 _MAX_FRAME = 1 << 33    # 8 GB: anything larger is a foreign protocol
+_MAC = hashlib.sha256().digest_size
 
 
-def _recv_msg(sock: socket.socket) -> Any:
+def _send_msg(sock: socket.socket, obj: Any,
+              secret: Optional[bytes] = None) -> None:
+    out = bytearray()
+    _enc(obj, out)
+    if secret is None:
+        secret = _wire_secret()
+    mac = (hmac_mod.new(secret, bytes(out), hashlib.sha256).digest()
+           if secret else b"")
+    sock.sendall(_LEN.pack(len(out) + len(mac)) + mac + out)
+
+
+def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None):
+    """Returns (message, authenticated: bool)."""
     hdr = b""
     while len(hdr) < _LEN.size:
         chunk = sock.recv(_LEN.size - len(hdr))
@@ -80,7 +212,27 @@ def _recv_msg(sock: socket.socket) -> Any:
         if not chunk:
             raise ConnectionError("kvstore server connection closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    if secret is None:
+        secret = _wire_secret()
+    authed = False
+    if secret:
+        if n < _MAC or not hmac_mod.compare_digest(
+                hmac_mod.new(secret, bytes(buf[_MAC:]),
+                             hashlib.sha256).digest(), bytes(buf[:_MAC])):
+            raise ConnectionError("kvstore frame failed HMAC check")
+        buf = buf[_MAC:]
+        authed = True
+    try:
+        msg, pos = _dec(memoryview(buf), 0)
+    except ConnectionError:
+        raise
+    except Exception as e:    # struct.error / TypeError / ValueError
+        # from malformed bytes: reject as a protocol error, never let
+        # a foreign frame crash the serving thread
+        raise ConnectionError(f"malformed kvstore frame ({e})") from e
+    if pos != len(buf):
+        raise ConnectionError("trailing bytes in kvstore frame")
+    return msg, authed
 
 
 class KVStoreServer:
@@ -93,6 +245,18 @@ class KVStoreServer:
         # optimizer any more than they share keys
         self._updaters: Dict[Any, Any] = {}
         self._lock = threading.Lock()
+        # captured once: a later env mutation must not silently change
+        # what this server authenticates against
+        self._secret = _wire_secret()
+        self._loopback = host in ("127.0.0.1", "localhost", "::1")
+        if not self._loopback and not self._secret:
+            import warnings
+            warnings.warn(
+                "mxtpu kvstore server binding a non-loopback interface "
+                "without MXTPU_PS_SECRET — frames are unauthenticated; "
+                "set_optimizer (pickled payload) will be refused. Set "
+                "MXTPU_PS_SECRET on every rank for multi-host dist_async.",
+                RuntimeWarning, stacklevel=2)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -115,19 +279,19 @@ class KVStoreServer:
         with conn:
             while True:
                 try:
-                    msg = _recv_msg(conn)
+                    msg, authed = _recv_msg(conn, self._secret)
                 except (ConnectionError, OSError):
                     return
                 try:
-                    reply = self._handle(msg)
+                    reply = self._handle(msg, authed)
                 except Exception as e:      # surface server errors to
                     reply = ("err", repr(e))  # the pushing worker
                 try:
-                    _send_msg(conn, reply)
+                    _send_msg(conn, reply, self._secret)
                 except (ConnectionError, OSError):
                     return
 
-    def _handle(self, msg):
+    def _handle(self, msg, authed: bool = False):
         op = msg[0]
         if op == "ping":
             return ("ok", "mxtpu-ps")
@@ -177,19 +341,28 @@ class KVStoreServer:
                 return ("ok", rows, self._store[key][rows].copy())
         if op == "set_optimizer":
             _, ns, blob = msg
+            # the one pickled payload on the wire (reference:
+            # _send_command_to_servers ships the optimizer itself).
+            # Unpickling executes code, so only trusted peers may send
+            # it: HMAC-authenticated frames, or a loopback-only bind.
+            if not (authed or self._loopback):
+                return ("err",
+                        "set_optimizer refused: unauthenticated peer on "
+                        "a non-loopback bind (set MXTPU_PS_SECRET)")
             new = _NumpyUpdater(pickle.loads(blob))
-            old = self._updaters.get(ns)
-            if old is not None and hasattr(old, "_optimizer"):
-                # hyperparameter refresh, not a restart: keep the
-                # schedule position AND the per-key optimizer state
-                # (Adam moments, momentum) — only the hyperparameters
-                # change
-                new._optimizer._index_update_count = dict(
-                    old._optimizer._index_update_count)
-                new._optimizer.num_update = old._optimizer.num_update
-                new._updater.states = old._updater.states
-                new._updater.states_synced = old._updater.states_synced
-            self._updaters[ns] = new
+            with self._lock:     # a racing push must never see a
+                old = self._updaters.get(ns)  # half-transplanted state
+                if old is not None and hasattr(old, "_optimizer"):
+                    # hyperparameter refresh, not a restart: keep the
+                    # schedule position AND the per-key optimizer state
+                    # (Adam moments, momentum) — only the
+                    # hyperparameters change
+                    new._optimizer._index_update_count = dict(
+                        old._optimizer._index_update_count)
+                    new._optimizer.num_update = old._optimizer.num_update
+                    new._updater.states = old._updater.states
+                    new._updater.states_synced = old._updater.states_synced
+                self._updaters[ns] = new
             return ("ok",)
         if op == "drop_ns":
             _, ns = msg
@@ -276,6 +449,7 @@ class ServerClient:
         if host is None or port is None:
             host, port = server_address()
         self._addr = (host, port)
+        self._secret = _wire_secret()
         self._lock = threading.Lock()
         deadline = time.time() + timeout
         last = None
@@ -294,8 +468,8 @@ class ServerClient:
 
     def request(self, *msg):
         with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+            _send_msg(self._sock, msg, self._secret)
+            reply, _ = _recv_msg(self._sock, self._secret)
         if reply[0] == "err":
             raise MXNetError(f"kvstore server: {reply[1]}")
         return reply

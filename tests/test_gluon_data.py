@@ -94,24 +94,29 @@ def test_dataloader_with_transform():
     assert xb.dtype == np.float32
 
 
+class _PidDataset(gdata.ArrayDataset):
+    """Module-level (picklable): the forkserver/spawn worker path ships
+    the dataset to freshly-started workers via initargs (ADVICE r2 —
+    fork of a JAX-threaded parent can deadlock)."""
+
+    def __getitem__(self, idx):
+        import os
+        x, y = super().__getitem__(idx)
+        return x, np.float32(os.getpid())
+
+
 def test_dataloader_multiprocess_workers_match_single():
-    """VERDICT r1 #8: num_workers>0 (thread_pool=False) must FORK real
+    """VERDICT r1 #8: num_workers>0 (thread_pool=False) must run real
     worker processes and produce byte-identical batches in the same
     order as the single-process path."""
     import os
     import numpy as onp
-    from mxtpu.gluon.data import ArrayDataset
     from mxtpu.gluon.data.dataloader import DataLoader
-
-    class PidDataset(ArrayDataset):
-        def __getitem__(self, idx):
-            x, y = super().__getitem__(idx)
-            return x, onp.float32(os.getpid())
 
     rng = onp.random.default_rng(0)
     X = rng.standard_normal((25, 3)).astype(onp.float32)
     Y = onp.arange(25, dtype=onp.float32)
-    ds = PidDataset(X, Y)
+    ds = _PidDataset(X, Y)
 
     single = [b for b in DataLoader(ds, batch_size=4, num_workers=0)]
     multi = [b for b in DataLoader(ds, batch_size=4, num_workers=2)]
@@ -120,7 +125,7 @@ def test_dataloader_multiprocess_workers_match_single():
     for s, m in zip(single, multi):
         onp.testing.assert_array_equal(s[0].asnumpy(), m[0].asnumpy())
         pids.update(m[1].asnumpy().astype(onp.int64).tolist())
-    # the data was ACTUALLY built in forked workers
+    # the data was ACTUALLY built in worker processes
     assert os.getpid() not in pids
     assert len(pids) >= 1
 

@@ -301,6 +301,131 @@ def test_two_async_stores_coexist():
     np.testing.assert_allclose(o2.asnumpy(), [0, 0])
 
 
+def test_ps_wire_codec_roundtrip():
+    """The PS wire format is a SAFE tag codec (no pickle for data):
+    every message shape the protocol uses must round-trip, and foreign
+    bytes must be rejected rather than interpreted (ADVICE r2)."""
+    import numpy as np
+    from mxtpu.kvstore import server as psrv
+    cases = [
+        ("ping",),
+        ("init", (0, "w"), np.arange(6, dtype=np.float32).reshape(2, 3)),
+        ("push_many", [((0, "a"), np.ones((1,), np.float16)),
+                       ((0, "b"), np.zeros((2, 2), np.int64))]),
+        ("row_pull", (1, "tbl"), [0, 2, 5]),
+        ("set_optimizer", 0, b"\x80\x04opaque-blob"),
+        ("ok", None, True, False, 3.5, -7, "err msg",
+         np.array(2.5, np.float64)),          # 0-d array
+    ]
+    def same(a, b):
+        if isinstance(b, np.ndarray):
+            assert isinstance(a, np.ndarray)
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+        elif isinstance(b, (tuple, list)):
+            assert type(a) is type(b) and len(a) == len(b)
+            for x, y in zip(a, b):
+                same(x, y)
+        else:
+            assert a == b and type(a) is type(b)
+
+    for msg in cases:
+        out = bytearray()
+        psrv._enc(msg, out)
+        dec, pos = psrv._dec(memoryview(bytes(out)), 0)
+        assert pos == len(out)
+        same(dec, msg)
+    # a pickle frame (or any foreign bytes) must raise, never execute
+    import pickle
+    evil = pickle.dumps(("push", 0, "x"))
+    with pytest.raises(Exception):
+        psrv._dec(memoryview(evil), 0)
+    # unpicklable-on-purpose: arbitrary objects are not wire-safe
+    with pytest.raises(TypeError):
+        psrv._enc(("cmd", object()), bytearray())
+
+
+def test_ps_hmac_and_set_optimizer_gating(monkeypatch):
+    """With MXTPU_PS_SECRET set, frames are HMAC-authenticated end to
+    end; without it, set_optimizer is refused on non-loopback binds
+    (the one pickled payload must never come from an untrusted peer)."""
+    import pickle
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.kvstore import server as psrv
+    monkeypatch.setenv("MXTPU_PS_SECRET", "test-secret-r3")
+    monkeypatch.setenv("MXTPU_PS_PORT_OFFSET", "311")
+    srv = psrv.KVStoreServer("127.0.0.1", 9402)
+    try:
+        cl = psrv.ServerClient("127.0.0.1", 9402)
+        assert cl.request("ping")[1] == "mxtpu-ps"
+        cl.request("init", "k", np.ones((2,), np.float32))
+        blob = pickle.dumps(mx.optimizer.SGD(learning_rate=1.0))
+        cl.request("set_optimizer", None, blob)   # authed → accepted
+        cl.request("push", "k", np.ones((2,), np.float32))
+        _, val = cl.request("pull", "k")
+        np.testing.assert_allclose(val, [0.0, 0.0])  # 1 - 1.0*1
+        # a client with the WRONG secret must be rejected
+        monkeypatch.setenv("MXTPU_PS_SECRET", "wrong")
+        bad = psrv.ServerClient("127.0.0.1", 9402)
+        with pytest.raises(Exception):
+            bad.request("ping")
+        bad.close()
+        cl.close()
+    finally:
+        srv.stop()
+    # unauthenticated peer on a non-loopback bind: refuse the pickle op
+    monkeypatch.delenv("MXTPU_PS_SECRET")
+    srv2 = psrv.KVStoreServer("127.0.0.1", 9403)
+    try:
+        srv2._loopback = False    # simulate an external-interface bind
+        reply = srv2._handle(("set_optimizer", None, blob), authed=False)
+        assert reply[0] == "err" and "refused" in reply[1]
+        assert srv2._handle(("ping",), authed=False)[0] == "ok"
+    finally:
+        srv2.stop()
+
+
+def test_trainer_async_propagates_all_hyperparams():
+    """Mutating a non-lr hyperparameter (wd) on the live optimizer must
+    reach the server-side copy on the next step (ADVICE r2: the change
+    signature covers ALL hyperparameters, not just lr/rescale)."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import gluon, autograd
+    from mxtpu.gluon import nn
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    kv = mx.kv.create("dist_async")
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "wd": 0.0}, kvstore=kv)
+    x = mx.nd.array(np.ones((4, 2), np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)
+    w1 = net.weight.data().asnumpy()
+    tr._optimizer.wd = 0.5              # NOT lr, NOT rescale_grad
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)
+    w2 = net.weight.data().asnumpy()
+    # server SGD with wd: w - lr*(grad + wd*w) = w*(1-lr*wd) - lr*grad
+    np.testing.assert_allclose(w2, w1 * (1 - 0.1 * 0.5) - 0.1,
+                               rtol=1e-5)
+    # the fingerprint must be STABLE across steps when nothing changed
+    # (param weights mutate every step and live in param_dict — they
+    # must not be part of the signature, or every step re-ships the
+    # optimizer)
+    fp = tr._opt_fingerprint()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)
+    assert tr._opt_fingerprint() == fp
+
+
 @pytest.mark.slow
 def test_dist_compressed_allreduce_packed_wire(tmp_path):
     """allreduce_grads with 2-bit compression crosses processes as

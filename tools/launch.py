@@ -82,6 +82,8 @@ def launch_ssh(args, command):
     port = args.port or 9091
     coord = hosts[0]
     procs = []
+    import shlex
+    secret = os.environ.get("MXTPU_PS_SECRET")
     for rank in range(args.num_workers):
         envs = " ".join([
             f"DMLC_ROLE=worker",
@@ -89,7 +91,8 @@ def launch_ssh(args, command):
             f"DMLC_PS_ROOT_PORT={port}",
             f"DMLC_NUM_WORKER={args.num_workers}",
             f"DMLC_WORKER_ID={rank}",
-        ] + (args.env or []))
+        ] + ([f"MXTPU_PS_SECRET={shlex.quote(secret)}"] if secret else [])
+          + (args.env or []))
         cmd = f"cd {os.getcwd()} && {envs} {' '.join(command)}"
         procs.append(subprocess.Popen(["ssh", hosts[rank], cmd]))
     code = 0
@@ -112,6 +115,9 @@ def _dmlc_wrapper(rank_expr, args, coord, port):
         f"export DMLC_NUM_WORKER={args.num_workers}",
         f"export DMLC_WORKER_ID={rank_expr}",
     ]
+    if os.environ.get("MXTPU_PS_SECRET"):   # auth travels with the job
+        exports.append("export MXTPU_PS_SECRET="
+                       f"{shlex.quote(os.environ['MXTPU_PS_SECRET'])}")
     for e in (args.env or []):
         k, _, v = e.partition("=")
         exports.append(f"export {k}={shlex.quote(v)}")
