@@ -125,7 +125,7 @@ def bench_bert(batch=128, seq=128, n_mlm=20, steps=20):
     return samples_s, mfu
 
 
-def bench_llama(batch=4, seq=2048, steps=15):
+def bench_llama(batch=4, seq=2048, steps=15, cfg=None):
     from mxtpu.models import llama
     from mxtpu.parallel import mesh as pmesh, step as pstep
 
@@ -136,7 +136,7 @@ def bench_llama(batch=4, seq=2048, steps=15):
     # shallower beats deeper-narrower at equal params. dots_no_batch
     # remat saves weight-matmul outputs instead of recomputing the
     # whole layer (~3% step win measured).
-    cfg = llama.LlamaConfig(
+    cfg = cfg or llama.LlamaConfig(
         vocab_size=32000, dim=2048, n_layers=8, n_heads=16,
         n_kv_heads=8, hidden_dim=5632, max_seq_len=seq,
         attn_impl="flash", remat=True, remat_policy="dots_no_batch")
@@ -161,11 +161,27 @@ def bench_llama(batch=4, seq=2048, steps=15):
     return tokens_s, mfu, n_params
 
 
+def bench_smoke_run():
+    """One REAL train step on a tiny llama config — CI's bench-path
+    regression check (a jit/shape break here fails bench_smoke)."""
+    from mxtpu.models import llama
+    cfg = llama.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=128, max_seq_len=64, attn_impl="blockwise")
+    t_s, mfu, n_p = bench_llama(batch=2, seq=64, steps=2, cfg=cfg)
+    return {"metric": "smoke_llama_tokens_per_s", "value": round(t_s, 1),
+            "unit": "tok/s", "mfu": round(mfu, 4), "n_params": n_p,
+            "vs_baseline": 1.0}
+
+
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if only not in ("all", "resnet", "bert", "llama"):
+    if only not in ("all", "resnet", "bert", "llama", "smoke"):
         raise SystemExit(
-            f"usage: bench.py [all|resnet|bert|llama] (got {only!r})")
+            f"usage: bench.py [all|resnet|bert|llama|smoke] (got {only!r})")
+    if only == "smoke":
+        print(json.dumps(bench_smoke_run()))
+        return
     extras = []
     img_s = mfu_r = 0.0
     if only in ("all", "resnet"):
