@@ -161,6 +161,29 @@ def bench_llama(batch=4, seq=2048, steps=15, cfg=None):
     return tokens_s, mfu, n_params
 
 
+def bench_llama_decode(batch=32, prompt=128, new_tokens=256, reps=3):
+    """Autoregressive decode tok/s with the KV cache (VERDICT r2 #4):
+    one jitted generate program (prefill + lax.scan of decode steps)."""
+    from mxtpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, hidden_dim=5632, max_seq_len=prompt + new_tokens,
+        remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt),
+                              0, cfg.vocab_size)
+    gen = jax.jit(lambda p, t: llama.generate(cfg, p, t, new_tokens))
+    out = gen(params, toks)
+    int(jax.device_get(out[0, -1]))          # compile + drain
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = gen(params, toks)
+    int(jax.device_get(out[0, -1]))          # honest fence
+    dt = (time.perf_counter() - t0) / reps
+    return batch * new_tokens / dt
+
+
 def bench_smoke_run():
     """One REAL train step on a tiny llama config — CI's bench-path
     regression check (a jit/shape break here fails bench_smoke)."""
@@ -199,6 +222,10 @@ def main():
                        "value": round(t_s, 1), "unit": "tok/s",
                        "mfu": round(mfu_l, 3), "n_params": n_p,
                        "vs_baseline": round(mfu_l, 3)})
+        d_s = bench_llama_decode()
+        extras.append({"metric": "llama_500m_decode_tokens_per_s",
+                       "value": round(d_s, 1), "unit": "tok/s",
+                       "vs_baseline": 1.0})
     out = {
         "metric": "resnet50_train_throughput_per_chip",
         "value": round(img_s, 1),

@@ -40,7 +40,8 @@ from ..parallel.sharding import ShardingRules, constrain
 
 __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "loss_fn", "chunked_softmax_xent", "sharding_rules",
-           "CONFIGS"]
+           "CONFIGS", "init_cache", "prefill", "decode_step",
+           "generate"]
 
 
 @dataclass(frozen=True)
@@ -372,3 +373,161 @@ def loss_fn(cfg: LlamaConfig, mesh: Optional[Mesh] = None):
                                        axis=-1)[..., 0]
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     return loss
+
+
+# ---------------------------------------------------------------------------
+# inference: KV-cache prefill + decode (VERDICT r2 #4)
+# ---------------------------------------------------------------------------
+# The reference shipped a dedicated fixed-graph inference surface
+# (``src/c_api/c_predict_api.cc`` + ``benchmark_score.py`` [path cites
+# — unverified]); the TPU-era equivalent for a causal LM is
+# prefill-then-decode over a preallocated KV cache: static shapes
+# throughout (cache sized to max_len, position as a traced scalar), so
+# the whole generate loop compiles to ONE program with a lax.scan —
+# no per-token dispatch, no dynamic shapes.
+
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
+    """Preallocated GQA KV cache: (L, b, n_kv_heads, max_len, hd) in
+    the compute dtype, plus the traced write position."""
+    hd = cfg.head_dim
+    shape = (cfg.n_layers, batch_size, cfg.n_kv_heads, max_len, hd)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
+                  x, lp, ck, cv):
+    """One block over the cache. x: (b, s, dim) where s is the prompt
+    length (prefill) or 1 (decode). ck/cv: (b, kvh, max_len, hd).
+    Returns (x, ck, cv) with the new keys/values written at
+    [pos : pos+s]."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = q.transpose(0, 2, 1, 3)          # (b, h, s, hd)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    zero = jnp.zeros((), jnp.int32)
+    idx = (zero, zero, pos.astype(jnp.int32), zero)
+    ck = lax.dynamic_update_slice(ck, k.astype(dt), idx)
+    cv = lax.dynamic_update_slice(cv, v.astype(dt), idx)
+
+    # attend q against the whole cache, masked to the causal prefix:
+    # key j visible to query i iff j <= pos + i. GQA-native: group the
+    # q heads per kv head instead of materializing repeated KV (the
+    # repeat would copy the whole cache every layer, every step)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    qg = q.reshape(b, cfg.n_kv_heads, rep, s, hd)
+    logits = jnp.einsum("bgrsd,bgkd->bgrsk", qg, ck,
+                        preferred_element_type=jnp.float32)
+    logits = logits / math.sqrt(hd)
+    kpos = jnp.arange(max_len)[None, :]             # (1, max_len)
+    qpos = pos + jnp.arange(s)[:, None]             # (s, 1)
+    logits = jnp.where(kpos <= qpos, logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1).astype(dt)
+    o = jnp.einsum("bgrsk,bgkd->bgrsd", p, cv)
+    o = o.reshape(b, cfg.n_heads, s, hd)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    x = x + o @ lp["wo"].astype(dt)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    return x, ck, cv
+
+
+def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
+                    last_only: bool = False):
+    """Shared prefill/decode body: runs the stack over the cache and
+    returns (logits (b, s, V) f32, new cache). ``last_only`` applies
+    the lm_head to the final position only — generation never needs
+    (and must not pay for) full-prompt logits."""
+    b, s = tokens.shape
+    max_len = cache["k"].shape[3]
+    pos = cache["pos"]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    # rope tables for absolute positions pos..pos+s from one static
+    # (max_len, hd/2) table — keeps the program shape-static
+    cos_t, sin_t = rope_tables(cfg, max_len)
+    cos = lax.dynamic_slice_in_dim(cos_t, pos, s, axis=0)
+    sin = lax.dynamic_slice_in_dim(sin_t, pos, s, axis=0)
+
+    def body(x, xs):
+        lp, ck, cv = xs
+        x, ck, cv = _layer_cached(cfg, cos, sin, pos, max_len,
+                                  x, lp, ck, cv)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x,
+                           (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if last_only:
+        x = x[:, -1:]
+    logits = jnp.einsum("bsd,dv->bsv", x,
+                        _head(cfg, params).astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    new_cache = {"k": ck, "v": cv, "pos": pos + s}
+    return logits, new_cache
+
+
+def prefill(cfg: LlamaConfig, params, tokens, cache):
+    """Run the prompt through the stack, filling the cache. Returns
+    (logits (b, s, V) f32 for every prompt position, cache)."""
+    return _forward_cached(cfg, params, tokens, cache)
+
+
+def decode_step(cfg: LlamaConfig, params, token, cache):
+    """One autoregressive step. token: (b, 1) int32. Returns
+    (logits (b, V) f32 for the next position, cache)."""
+    logits, cache = _forward_cached(cfg, params, token, cache)
+    return logits[:, 0], cache
+
+
+def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
+             *, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None):
+    """Autoregressive generation: prefill + a lax.scan of decode
+    steps — ONE jitted program end to end when wrapped in jax.jit
+    (max_new_tokens static). temperature=0 is greedy; otherwise
+    softmax sampling at the given temperature.
+
+    Returns (b, prompt_len + max_new_tokens) tokens."""
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    b, s0 = prompt.shape
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_cache(cfg, b, s0 + max_new_tokens)
+    logits, cache = _forward_cached(cfg, params, prompt, cache,
+                                    last_only=True)
+
+    def sample(rng, lg):
+        if temperature == 0.0:
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, lg / temperature, axis=-1).astype(jnp.int32)
+
+    rng, sub = jax.random.split(rng)
+    first = sample(sub, logits[:, -1])
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        logits, cache = decode_step(cfg, params, tok[:, None], cache)
+        rng, sub = jax.random.split(rng)
+        nxt = sample(sub, logits)
+        return (cache, nxt, rng), nxt
+
+    (cache, _, _), rest = lax.scan(
+        step, (cache, first, rng), None, length=max_new_tokens - 1)
+    out = jnp.concatenate(
+        [prompt, first[:, None], rest.transpose(1, 0)], axis=1)
+    return out

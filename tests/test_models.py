@@ -79,6 +79,59 @@ def test_chunked_ce_matches_full(tiny_cfg):
         replace(cfg, vocab_size=128256, ce_chunk=None)) == 0
 
 
+def test_llama_kv_cache_decode_matches_forward(tiny_cfg):
+    """VERDICT r2 #4: prefill + per-token KV-cache decode must produce
+    the same logits as the full forward pass at every position."""
+    cfg = replace(tiny_cfg, dtype=jnp.float32, attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (2, 12), 0,
+                                cfg.vocab_size)
+    ref = llama.forward(cfg, params, tokens)          # (b, 12, V)
+
+    s0 = 5
+    cache = llama.init_cache(cfg, 2, 12)
+    pre_logits, cache = llama.prefill(cfg, params, tokens[:, :s0], cache)
+    np.testing.assert_allclose(np.asarray(pre_logits),
+                               np.asarray(ref[:, :s0]),
+                               rtol=2e-4, atol=2e-4)
+    assert int(cache["pos"]) == s0
+    for i in range(s0, 12):       # feed the TRUE next token each step
+        step_logits, cache = llama.decode_step(
+            cfg, params, tokens[:, i:i + 1], cache)
+        np.testing.assert_allclose(np.asarray(step_logits),
+                                   np.asarray(ref[:, i]),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"pos {i}")
+    assert int(cache["pos"]) == 12
+
+
+def test_llama_generate(tiny_cfg):
+    """generate() is greedy-deterministic, jittable end to end, and
+    its continuation agrees with argmax over full forward logits."""
+    cfg = replace(tiny_cfg, dtype=jnp.float32, attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (2, 6), 0,
+                                cfg.vocab_size)
+    gen = jax.jit(lambda p, t: llama.generate(cfg, p, t, 5))
+    out = gen(params, prompt)
+    assert out.shape == (2, 11)
+    np.testing.assert_array_equal(np.asarray(out[:, :6]),
+                                  np.asarray(prompt))
+    # greedy property: each generated token is the argmax of the full
+    # forward logits over the sequence so far
+    seq = np.asarray(out)
+    for i in range(6, 11):
+        lg = llama.forward(cfg, params, jnp.asarray(seq[:, :i]))
+        np.testing.assert_array_equal(
+            np.asarray(jnp.argmax(lg[:, -1], axis=-1)), seq[:, i],
+            err_msg=f"pos {i}")
+    # temperature sampling is deterministic given the rng
+    a = llama.generate(cfg, params, prompt, 4, temperature=0.8,
+                       rng=jax.random.PRNGKey(3))
+    b = llama.generate(cfg, params, prompt, 4, temperature=0.8,
+                       rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_llama_causality(tiny_cfg):
     """Changing a future token must not change past logits."""
     cfg = replace(tiny_cfg, dtype=jnp.float32, attn_impl="dense")
