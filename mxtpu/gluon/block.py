@@ -240,6 +240,49 @@ class Block:
         for p in self.collect_params().values():
             p.cast(dtype)
 
+    def shard(self, mesh, rules) -> "Block":
+        """Place every Parameter onto ``mesh`` per the ShardingRules
+        table, keyed on parameter NAMES (VERDICT r2 #1 — the Gluon
+        surface's entry to dp/fsdp/tp/sp parallelism; the reference
+        reached multi-device through per-GPU copies + KVStore instead).
+
+        After ``shard``, a hybridized forward is one GSPMD-partitioned
+        program (XLA inserts the collectives), and
+        ``Trainer.make_fused_step(net)`` lowers the whole train step
+        to one donated program. Re-sharding with a different mesh or
+        rules is allowed and clears compiled caches (this block and
+        all descendants). Gradient buffers are re-created ZEROED on
+        the parameter's sharding — shard() is a placement change, not
+        a step boundary; don't call it mid-accumulation."""
+        import jax as _jax
+        from jax.sharding import NamedSharding
+        for p in self.collect_params().values():
+            if p._data is None:
+                if p._deferred_init:
+                    raise MXNetError(
+                        f"parameter {p.name} has a deferred shape; run "
+                        "one forward before shard() so shapes resolve")
+                raise MXNetError(
+                    f"parameter {p.name} is uninitialized; call "
+                    "initialize() before shard()")
+            sharding = NamedSharding(mesh, rules.spec(p.name))
+            grad_req = p._grad_req
+            p._data._set_data(_jax.device_put(p._data._data, sharding))
+            if grad_req != "null":       # grads live on the same layout
+                p._data.attach_grad(grad_req)
+                p._data.grad._set_data(
+                    _jax.device_put(p._data.grad._data, sharding))
+            p._sharding = sharding
+
+        def mark(b):
+            b._mesh, b._shard_rules = mesh, rules
+            if hasattr(b, "_clear_cached_op"):
+                b._clear_cached_op()
+            for c in b._children.values():
+                mark(c)
+        mark(self)
+        return self
+
     def apply(self, fn: Callable[["Block"], None]) -> "Block":
         for child in self._children.values():
             child.apply(fn)
@@ -301,11 +344,19 @@ class HybridBlock(Block):
         self._out_tree_for: Dict[Any, Any] = {}
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
-                  static_shape: bool = False, **kwargs) -> None:
+                  static_shape: bool = False, mesh=None, rules=None,
+                  **kwargs) -> None:
+        """``hybridize(mesh=..., rules=...)`` additionally shards the
+        net (sugar for ``hybridize(); shard(mesh, rules)``)."""
         self._active = active
         self._clear_cached_op()
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
+        if mesh is not None:
+            if rules is None:
+                from ..parallel.sharding import ShardingRules
+                rules = ShardingRules([])
+            self.shard(mesh, rules)
 
     def _clear_cached_op(self) -> None:
         self._cached_op_params = None
@@ -367,6 +418,24 @@ class HybridBlock(Block):
             raw = self._build_raw(training, in_tree, len(flat_in), cache_key)
             self._raw_cache[cache_key] = raw
         datas = [a._data if isinstance(a, NDArray) else a for a in flat_in]
+        mesh = getattr(self, "_mesh", None)
+        if mesh is not None:
+            # sharded net: inputs must live on the same mesh as the
+            # params. Inputs the caller already placed on THIS mesh
+            # (e.g. a dp-sharded inference batch) pass through
+            # untouched; everything else replicates. The fused train
+            # step dp-shards its own batch.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def place(d):
+                if not isinstance(d, jax.Array):
+                    return d
+                s = d.sharding
+                if isinstance(s, NamedSharding) and s.mesh == mesh:
+                    return d
+                return jax.device_put(
+                    d, NamedSharding(mesh, PartitionSpec()))
+            datas = [place(d) for d in datas]
         datas += [p.data()._data for p in params]
         datas.append(_random._next_key())
 
@@ -374,8 +443,16 @@ class HybridBlock(Block):
         parent_arrays = list(flat_in) + [p.data() for p in params] + [None]
         parents = _parents_of(
             [a if isinstance(a, NDArray) else None for a in parent_arrays])
-        result, node = autograd.invoke(raw, datas, parents,
-                                       f"CachedOp[{self.name}]", has_aux=True)
+        import contextlib
+        if mesh is not None:           # sharded net: trace/run with the
+            from ..parallel.mesh import use_mesh   # ambient mesh so
+            cm = use_mesh(mesh)        # constrain() in model code binds
+        else:
+            cm = contextlib.nullcontext()
+        with cm:
+            result, node = autograd.invoke(
+                raw, datas, parents, f"CachedOp[{self.name}]",
+                has_aux=True)
         outs, aux = result
         # write mutated aux state back into the real parameters
         aux_params = self._aux_params_for[cache_key]

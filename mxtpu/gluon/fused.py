@@ -1,0 +1,263 @@
+"""Gluon ↔ mesh integration: the ONE-program sharded train step for
+HybridBlocks (VERDICT r2 #1; BASELINE config 5).
+
+The reference's primary user surface reached multi-device training
+through Module/Gluon ``Trainer`` orchestrating per-GPU executors +
+KVStore push/pull (``python/mxnet/module/executor_group.py``,
+``gluon/trainer.py`` [path cites — unverified]). The TPU-native
+equivalent must not orchestrate: ``net.shard(mesh, rules)`` places
+every Parameter by the rule table (NamedSharding keyed on parameter
+NAMES), and ``Trainer.make_fused_step(net)`` lowers forward + loss +
+backward + optimizer update into ONE jitted, donated XLA program over
+the mesh — the same shape ``mxtpu.parallel.step.make_train_step``
+gives functional models. Gradient reduction is implicit: the batch is
+dp-sharded while params are replicated/fsdp-sharded, so XLA inserts
+the psum/reduce-scatter on the backward pass.
+
+The optimizer update runs INSIDE the program via pure per-family
+kernels that take the schedule position ``t`` and hyperparameters as
+traced scalars — so ``trainer.set_learning_rate`` / lr schedulers /
+``wd`` edits never retrace. Optimizer state is created sharded like
+its parameter (the ``opt_state_shardings`` rule from parallel/step).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray import random as _random
+from ..parallel.mesh import use_mesh
+from ..parallel.sharding import batch_spec
+
+__all__ = ["make_fused_step"]
+
+
+# ---------------------------------------------------------------------------
+# pure optimizer kernels: (opt, t, w, g, state, lr, wd, rescale) ->
+# (new_w, new_state). t/lr/wd/rescale are TRACED scalars; the math
+# mirrors each Optimizer.update exactly (same ops, same order) so the
+# fused path reproduces the imperative trajectory.
+# ---------------------------------------------------------------------------
+def _clipped(opt, g, rescale):
+    g = g * rescale
+    if opt.clip_gradient is not None:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    return g
+
+
+def _pure_sgd(opt, t, w, g, state, lr, wd, rescale):
+    g = _clipped(opt, g, rescale) + wd * w
+    if opt.momentum == 0.0:
+        return w - lr * g, state
+    mom = opt.momentum * state - lr * g
+    return w + mom, mom
+
+
+def _pure_nag(opt, t, w, g, state, lr, wd, rescale):
+    g = _clipped(opt, g, rescale) + wd * w
+    if opt.momentum == 0.0:
+        return w - lr * g, state
+    mom = opt.momentum * state + g
+    return w - lr * (g + opt.momentum * mom), mom
+
+
+def _pure_adam(opt, t, w, g, state, lr, wd, rescale):
+    tf = t.astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1.0 - opt.beta2 ** tf) / (1.0 - opt.beta1 ** tf)
+    m, v = state
+    g = _clipped(opt, g, rescale) + wd * w
+    m = opt.beta1 * m + (1 - opt.beta1) * g
+    v = opt.beta2 * v + (1 - opt.beta2) * jnp.square(g)
+    return w - lr_t * m / (jnp.sqrt(v) + opt.epsilon), (m, v)
+
+
+def _pure_adamw(opt, t, w, g, state, lr, wd, rescale):
+    tf = t.astype(jnp.float32)
+    m, v = state
+    g = _clipped(opt, g, rescale)
+    m = opt.beta1 * m + (1 - opt.beta1) * g
+    v = opt.beta2 * v + (1 - opt.beta2) * jnp.square(g)
+    mhat = m / (1 - opt.beta1 ** tf)
+    vhat = v / (1 - opt.beta2 ** tf)
+    return (w - lr * (mhat / (jnp.sqrt(vhat) + opt.epsilon) + wd * w),
+            (m, v))
+
+
+_PURE_UPDATES: Dict[type, Callable] = {
+    opt_mod.SGD: _pure_sgd,
+    opt_mod.NAG: _pure_nag,
+    opt_mod.AdamW: _pure_adamw,
+    opt_mod.Adam: _pure_adam,
+}
+
+
+def _pure_update_for(optimizer):
+    # walk the MRO so AdamW (an Adam subclass) resolves to its own
+    # decoupled-decay kernel, not Adam's
+    for cls in type(optimizer).__mro__:
+        fn = _PURE_UPDATES.get(cls)
+        if fn is not None:
+            return fn
+    raise MXNetError(
+        "make_fused_step supports "
+        f"{[c.__name__ for c in _PURE_UPDATES]} optimizers, got "
+        f"{type(optimizer).__name__}; use the classic Trainer.step "
+        "path or register a pure kernel in _PURE_UPDATES")
+
+
+def _init_opt_state(optimizer, p, sharding):
+    """Optimizer state for one param, created ON its sharding (an
+    fsdp-sharded 8B param's Adam moments must never materialize on one
+    device) — opt_state_shardings' rule, applied at creation."""
+    if isinstance(optimizer, opt_mod.Adam):
+        return jax.jit(lambda x: (jnp.zeros_like(x), jnp.zeros_like(x)),
+                       out_shardings=(sharding, sharding))(p.data()._data)
+    if getattr(optimizer, "momentum", 0.0):
+        return jax.jit(jnp.zeros_like,
+                       out_shardings=sharding)(p.data()._data)
+    return None
+
+
+def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
+    """Build ``step(*batch) -> loss`` running the whole training step
+    as ONE donated XLA program over ``net``'s mesh.
+
+    - ``net`` must be initialized and ``shard(mesh, rules)``-ed.
+    - ``loss_fn(out...) -> scalar NDArray`` maps the net output to the
+      loss; ``None`` means the net's output IS the loss (e.g. a model
+      whose forward takes (tokens, labels)).
+    - params and optimizer state are donated each call and written
+      back into the live Parameters, so the Gluon surface
+      (param.data(), save_parameters, checkpointing) stays truthful.
+    - ``step.num_compiles()`` counts compiled programs (one per
+      input-shape signature) — the Trainer-step-is-ONE-program
+      invariant the KVStore veneer could never give.
+    """
+    mesh = getattr(net, "_mesh", None)
+    rules = getattr(net, "_shard_rules", None)
+    if mesh is None:
+        raise MXNetError("net.shard(mesh, rules) must run before "
+                         "make_fused_step")
+    optimizer = trainer._optimizer
+    pure_update = _pure_update_for(optimizer)
+    params: List = list(trainer._params)
+    for p in params:
+        if p._data is None:
+            raise MXNetError(f"parameter {p.name} is uninitialized; "
+                             "initialize (and run one forward if shapes "
+                             "defer) before net.shard/make_fused_step")
+    live = [p for p in params if p.grad_req != "null"]
+    frozen = [p for p in params if p.grad_req == "null"]
+    shardings = {p.name: NamedSharding(mesh, rules.spec(p.name))
+                 for p in params}
+    opt_states = [_init_opt_state(optimizer, p, shardings[p.name])
+                  for p in live]
+    bshard = NamedSharding(mesh, batch_spec(mesh))
+    # indices (into `frozen`) of params the forward mutates (BatchNorm
+    # running stats) — recorded AT TRACE TIME, read at writeback
+    mutated_idx: List[int] = []
+
+    def pure_loss(live_vals, frozen_vals, batch_vals, key):
+        from .block import _TRACE_DEPTH
+        from .. import autograd
+        for p, v in zip(live, live_vals):
+            p._bind_tracer(v)
+        for p, v in zip(frozen, frozen_vals):
+            p._bind_tracer(v)
+        _random.push_trace_key(key)
+        _TRACE_DEPTH.depth = getattr(_TRACE_DEPTH, "depth", 0) + 1
+        try:
+            with autograd.pause(train_mode=True):
+                out = net(*[NDArray(b) for b in batch_vals])
+                if loss_fn is not None:
+                    out = loss_fn(*out) if isinstance(out, tuple) \
+                        else loss_fn(out)
+        finally:
+            _TRACE_DEPTH.depth -= 1
+            _random.pop_trace_key()
+            for p in live:
+                p._unbind_tracer()
+            new_frozen = [p._unbind_tracer() for p in frozen]
+        mutated_idx[:] = [i for i, (v, nv) in
+                          enumerate(zip(frozen_vals, new_frozen))
+                          if nv is not v]
+        aux = tuple(new_frozen[i] for i in mutated_idx)
+        loss = out._data if isinstance(out, NDArray) else out
+        if loss.ndim != 0:
+            raise MXNetError(
+                "fused step needs a SCALAR loss; got shape "
+                f"{loss.shape} — reduce (e.g. .mean()) in loss_fn")
+        return loss, aux
+
+    grad_fn = jax.value_and_grad(pure_loss, has_aux=True)
+
+    def _step(live_vals, states, frozen_vals, batch_vals, hyper, key):
+        (loss, aux), grads = grad_fn(live_vals, frozen_vals,
+                                     batch_vals, key)
+        new_live, new_states = [], []
+        for p, w, g, s in zip(live, live_vals, grads, states):
+            lr = hyper["lr"] * p.lr_mult
+            wd = hyper["wd"] * p.wd_mult
+            nw, ns = pure_update(optimizer, hyper["t"], w, g, s,
+                                 lr.astype(w.dtype), wd.astype(w.dtype),
+                                 hyper["rescale"].astype(w.dtype))
+            # pin the updated param to its rule-table layout so every
+            # step receives exactly the shard(...) placement
+            nw = jax.lax.with_sharding_constraint(nw, shardings[p.name])
+            new_live.append(nw)
+            new_states.append(ns)
+        return loss, new_live, new_states, aux
+
+    # outputs pinned to the rule-table shardings so the NEXT step's
+    # donated inputs carry identical layouts — without this a 1-device
+    # mesh returns SingleDeviceSharding outputs and step 2 recompiles
+    live_out_sh = [shardings[p.name] for p in live]
+    state_out_sh = [None if s is None
+                    else jax.tree.map(lambda _, sh=shardings[p.name]: sh, s)
+                    for p, s in zip(live, opt_states)]
+    jitted = jax.jit(_step, donate_argnums=(0, 1),
+                     out_shardings=(None, live_out_sh, state_out_sh,
+                                    None))
+
+    def step(*batch):
+        """One fused train step; returns the loss NDArray."""
+        from .. import autograd
+        batch_vals = [jax.device_put(
+            b._data if isinstance(b, NDArray) else jnp.asarray(b),
+            bshard) for b in batch]
+        live_vals = [p.data()._data for p in live]
+        frozen_vals = [p.data()._data for p in frozen]
+        # schedule position + hyperparams as traced scalars: lr edits,
+        # schedulers, wd changes never retrace
+        for i in range(len(live)):
+            optimizer._update_count(i)
+        hyper = {
+            "lr": jnp.asarray(optimizer.learning_rate, jnp.float32),
+            "wd": jnp.asarray(optimizer.wd, jnp.float32),
+            "rescale": jnp.asarray(optimizer.rescale_grad, jnp.float32),
+            "t": jnp.asarray(optimizer.num_update, jnp.int32),
+        }
+        key = _random._next_key()
+        with use_mesh(mesh):
+            loss, new_live, new_states, aux = jitted(
+                live_vals, opt_states, frozen_vals, batch_vals, hyper,
+                key)
+        with autograd.pause():
+            for p, v in zip(live, new_live):
+                p._data._set_data(v)
+            for i, v in zip(mutated_idx, aux):
+                frozen[i]._data._set_data(v)
+        opt_states[:] = new_states
+        return NDArray(loss)
+
+    step.num_compiles = lambda: int(jitted._cache_size())
+    step._jitted = jitted
+    step._opt_states = opt_states
+    step._shardings = shardings
+    return step

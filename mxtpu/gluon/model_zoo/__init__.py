@@ -1,3 +1,4 @@
 """Model zoo (reference ``python/mxnet/gluon/model_zoo/``)."""
 from . import vision
+from .llama import GluonLlama
 from .vision import get_model

@@ -1,0 +1,158 @@
+"""Llama as a Gluon HybridBlock — BASELINE config 5's named form
+("Llama-3-8B as Gluon HybridBlock ... stress hybridize→HLO at LLM
+scale"); VERDICT r2 #1.
+
+Design: the block OWNS the parameters (Gluon semantics: initialize /
+save_parameters / load_parameters / hybridize / shard all work), while
+the math is the functional core in ``mxtpu.models.llama`` — scan-over-
+layers with stacked per-layer weights, tuned flash attention
+(``mxtpu.ops.attention``), chunked cross-entropy. One source of truth
+for the numerics means the Gluon surface reproduces the functional
+trajectory exactly (tested in test_gluon_mesh.py).
+
+Parameter NAMES match the functional pytree paths ("layers/wq",
+"tok_embed", ...) so ``mxtpu.models.llama.sharding_rules`` applies to
+the Gluon block unchanged — rules are keyed on parameter names.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as _np
+
+from ... import ndarray as nd
+from ...models import llama as _fl
+from ...ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["GluonLlama"]
+
+
+# attribute-safe alias ↔ functional pytree path
+_PARAM_PATHS = {
+    "tok_embed": ("tok_embed",),
+    "layers_attn_norm": ("layers", "attn_norm"),
+    "layers_wq": ("layers", "wq"),
+    "layers_wk": ("layers", "wk"),
+    "layers_wv": ("layers", "wv"),
+    "layers_wo": ("layers", "wo"),
+    "layers_ffn_norm": ("layers", "ffn_norm"),
+    "layers_w_gate": ("layers", "w_gate"),
+    "layers_w_up": ("layers", "w_up"),
+    "layers_w_down": ("layers", "w_down"),
+    "final_norm": ("final_norm",),
+    "lm_head": ("lm_head",),
+}
+
+
+class GluonLlama(HybridBlock):
+    """Llama causal LM as a HybridBlock.
+
+    - ``net(tokens)`` → logits (b, s, vocab) f32.
+    - ``net(tokens, tokens)`` → scalar training loss (causal shift +
+      chunked CE inside — identical math to
+      ``mxtpu.models.llama.loss_fn``).
+    - ``net.shard(mesh, mxtpu.models.llama.sharding_rules(cfg))``
+      places the weights Megatron/fsdp-style; with ``hybridize()`` +
+      ``Trainer.make_fused_step`` the train step is one sharded
+      program.
+    """
+
+    def __init__(self, cfg: Optional[_fl.LlamaConfig] = None,
+                 prefix: Optional[str] = None, params=None, **overrides):
+        # parameter NAMES are the functional pytree paths regardless of
+        # prefix (sharding rules key on them); prefix scopes the block
+        super().__init__(prefix=prefix if prefix is not None else "",
+                         params=params)
+        cfg = cfg or _fl.LlamaConfig()
+        if overrides:
+            from dataclasses import replace
+            cfg = replace(cfg, **overrides)
+        self._cfg = cfg
+        abs_params = jax.eval_shape(
+            lambda: _fl.init_params(cfg, jax.random.PRNGKey(0)))
+        paths = dict(_PARAM_PATHS)
+        if cfg.tie_embeddings:
+            paths.pop("lm_head")
+        for attr, path in paths.items():
+            leaf = abs_params
+            for k in path:
+                leaf = leaf[k]
+            p = Parameter("/".join(path), shape=tuple(leaf.shape),
+                          dtype=_np.dtype(leaf.dtype).name)
+            self._reg_params[attr] = p
+            object.__setattr__(self, attr, p)
+
+    @property
+    def cfg(self) -> _fl.LlamaConfig:
+        return self._cfg
+
+    # -- pytree bridge -------------------------------------------------------
+    def _pytree(self, ps) -> dict:
+        tree: dict = {"layers": {}}
+        for attr, path in _PARAM_PATHS.items():
+            if attr not in ps:
+                continue
+            v = ps[attr]
+            v = v._data if isinstance(v, NDArray) else v
+            if len(path) == 1:
+                tree[path[0]] = v
+            else:
+                tree[path[0]][path[1]] = v
+        if not tree["layers"]:
+            del tree["layers"]
+        return tree
+
+    def load_pytree(self, params) -> None:
+        """Install a functional ``mxtpu.models.llama`` param pytree."""
+        for attr, path in _PARAM_PATHS.items():
+            if attr not in self._reg_params:
+                continue
+            leaf = params
+            for k in path:
+                leaf = leaf[k]
+            p = self._reg_params[attr]
+            if p._data is None:
+                p._load_init(nd.array(leaf))
+            else:
+                p.set_data(nd.array(leaf))
+
+    def as_pytree(self) -> dict:
+        """The live weights as a functional param pytree. Shares
+        buffers (no copy) — but a fused train step DONATES them, so
+        re-call this after each step rather than holding the tree
+        across steps."""
+        return self._pytree({a: p.data()
+                             for a, p in self._reg_params.items()})
+
+    # -- forward -------------------------------------------------------------
+    def hybrid_forward(self, F, tokens, labels=None, **ps):
+        """``net(tokens)`` → logits; ``net(tokens, tokens)`` → scalar
+        causal-LM loss. ``labels`` exists for the Gluon (data, label)
+        calling convention but MUST be the same token sequence — the
+        causal next-token shift happens inside (targets are
+        ``tokens[:, 1:]``); separate target sequences are not a
+        causal-LM concept and are rejected."""
+        params = self._pytree(ps)
+        tok = tokens._data if isinstance(tokens, NDArray) else tokens
+        if labels is None:
+            logits = _fl.forward(self._cfg, params, tok)
+            return NDArray(logits)
+        lab = labels._data if isinstance(labels, NDArray) else labels
+        if lab.shape != tok.shape:
+            raise ValueError(
+                "GluonLlama loss mode: labels must BE the input token "
+                f"sequence (got {lab.shape} vs {tok.shape}); the causal "
+                "shift is internal")
+        loss = _fl.loss_fn(self._cfg)(params, {"tokens": tok})
+        return NDArray(loss)
+
+    def generate(self, prompt, max_new_tokens: int, **kw):
+        """KV-cache autoregressive generation (functional
+        ``llama.generate`` over the live weights)."""
+        tok = prompt._data if isinstance(prompt, NDArray) else prompt
+        out = _fl.generate(self._cfg, self.as_pytree(), tok,
+                           max_new_tokens, **kw)
+        return NDArray(out)

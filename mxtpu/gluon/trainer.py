@@ -218,6 +218,14 @@ class Trainer:
         for i, p in live:
             updater(i, p.grad(), p.data())
 
+    def make_fused_step(self, net, loss_fn=None):
+        """ONE-program sharded train step for a ``net.shard(mesh,
+        rules)``-ed HybridBlock: forward + loss + backward + optimizer
+        update compile to a single donated XLA program over the mesh
+        (see ``mxtpu.gluon.fused``)."""
+        from .fused import make_fused_step
+        return make_fused_step(self, net, loss_fn)
+
     def zero_grad(self) -> None:
         for p in self._params:
             p.zero_grad()

@@ -1,0 +1,209 @@
+"""Gluon ↔ mesh integration (VERDICT r2 #1): net.shard(mesh, rules) +
+Trainer.make_fused_step must give the Gluon surface the SAME
+one-program sharded train step the functional models get from
+mxtpu.parallel.step — and the Gluon Llama must reproduce the
+functional trajectory.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from dataclasses import replace
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+from mxtpu.gluon.model_zoo import GluonLlama
+from mxtpu.models import llama
+from mxtpu.parallel import mesh as pmesh, step as pstep
+from mxtpu.parallel.sharding import ShardingRules, P
+
+
+def _copy_net(src, dst):
+    # insertion order — identical net structure, NOT name sort (global
+    # name counters give the two nets different numeric prefixes)
+    for p1, p2 in zip(src.collect_params().values(),
+                      dst.collect_params().values()):
+        p2.set_data(p1.data())
+
+
+def _dense_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(8, in_units=32))
+    net.initialize()
+    return net
+
+
+def test_fused_step_matches_classic_trainer():
+    """The one-program fused step must reproduce the classic
+    record/backward/Trainer.step trajectory (SGD+momentum+wd+clip),
+    and compile exactly ONE program across steps and lr changes."""
+    rng = np.random.default_rng(0)
+    X = mx.nd.array(rng.standard_normal((64, 16)).astype(np.float32))
+    Y = mx.nd.array(rng.standard_normal((64, 8)).astype(np.float32))
+    opt_args = {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01,
+                "clip_gradient": 1.0}
+
+    net_c = _dense_net()
+    net_f = _dense_net()
+    _copy_net(net_c, net_f)
+
+    # classic path
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd", dict(opt_args))
+    classic_losses = []
+    for step_i in range(4):
+        if step_i == 2:
+            tr_c.set_learning_rate(0.05)
+        with autograd.record():
+            loss = ((net_c(X) - Y) ** 2).mean()
+        loss.backward()
+        tr_c.step(1)
+        classic_losses.append(float(loss.asscalar()))
+
+    # fused path on a dp mesh over all devices
+    mesh = pmesh.create_mesh(dp=-1)
+    rules = ShardingRules([(r".*", P())])
+    net_f.hybridize()
+    net_f.shard(mesh, rules)
+    tr_f = gluon.Trainer(net_f.collect_params(), "sgd", dict(opt_args))
+    fused = tr_f.make_fused_step(
+        net_f, loss_fn=lambda out: ((out - Y) ** 2).mean())
+    fused_losses = []
+    for step_i in range(4):
+        if step_i == 2:
+            tr_f.set_learning_rate(0.05)
+        fused_losses.append(float(fused(X).asscalar()))
+
+    np.testing.assert_allclose(fused_losses, classic_losses,
+                               rtol=1e-5, atol=1e-6)
+    for pc, pf in zip(net_c.collect_params().values(),
+                      net_f.collect_params().values()):
+        np.testing.assert_allclose(pc.data().asnumpy(),
+                                   pf.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    # ONE compiled program despite 4 steps and an lr change
+    assert fused.num_compiles() == 1
+    # momentum state was created and sharded on the mesh
+    assert all(s is not None for s in fused._opt_states)
+
+
+def test_fused_step_batchnorm_aux_state():
+    """Non-differentiable state (BatchNorm running stats) must thread
+    through the fused program and land back in the Parameters, same as
+    the classic path."""
+    def bn_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=8), nn.BatchNorm(in_channels=16),
+                nn.Dense(4, in_units=16))
+        net.initialize()
+        return net
+
+    rng = np.random.default_rng(1)
+    X = mx.nd.array(rng.standard_normal((32, 8)).astype(np.float32))
+    Y = mx.nd.array(rng.standard_normal((32, 4)).astype(np.float32))
+
+    net_c, net_f = bn_net(), bn_net()
+    _copy_net(net_c, net_f)
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd",
+                         {"learning_rate": 0.1})
+    for _ in range(3):
+        with autograd.record():
+            loss = ((net_c(X) - Y) ** 2).mean()
+        loss.backward()
+        tr_c.step(1)
+
+    mesh = pmesh.create_mesh(dp=-1)
+    net_f.hybridize()
+    net_f.shard(mesh, ShardingRules([(r".*", P())]))
+    tr_f = gluon.Trainer(net_f.collect_params(), "sgd",
+                         {"learning_rate": 0.1})
+    fused = tr_f.make_fused_step(
+        net_f, loss_fn=lambda out: ((out - Y) ** 2).mean())
+    for _ in range(3):
+        fused(X)
+
+    stats = [n for n in net_c.collect_params()
+             if "running" in n]
+    assert stats, "BatchNorm running stats not found"
+    for pc, pf in zip(net_c.collect_params().values(),
+                      net_f.collect_params().values()):
+        np.testing.assert_allclose(
+            pc.data().asnumpy(), pf.data().asnumpy(),
+            rtol=1e-5, atol=1e-6, err_msg=pc.name)
+
+
+def test_gluon_llama_matches_functional_trajectory():
+    """BASELINE config 5's shape: Llama AS A GLUON HYBRIDBLOCK on a
+    dp×fsdp×tp mesh must reproduce the functional models/llama.py
+    trajectory, with params + optimizer state actually sharded, in ONE
+    compiled program."""
+    cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                  attn_impl="dense", remat=False)
+    rules = llama.sharding_rules(cfg)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (4, 32), 0,
+                                cfg.vocab_size)
+    lr = 0.1
+
+    # functional reference on the same mesh
+    mesh = pmesh.create_mesh(dp=1, fsdp=2, tp=2,
+                             devices=jax.devices()[:4])
+    state = pstep.init_state(params, optax.sgd(lr), mesh, rules)
+    fstep = pstep.make_train_step(llama.loss_fn(cfg), optax.sgd(lr),
+                                  mesh, rules)
+    f_losses = []
+    for _ in range(3):
+        state, loss = fstep(state, {"tokens": tokens})
+        f_losses.append(float(loss))
+
+    # Gluon block, same weights, same mesh/rules
+    net = GluonLlama(cfg)
+    net.load_pytree(params)
+    net.hybridize()
+    net.shard(mesh, rules)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": lr, "wd": 0.0})
+    fused = tr.make_fused_step(net)         # net(tokens, labels) → loss
+    tok_nd = mx.nd.array(np.asarray(tokens))
+    g_losses = [float(fused(tok_nd, tok_nd).asscalar()) for _ in range(3)]
+
+    np.testing.assert_allclose(g_losses, f_losses, rtol=1e-6, atol=1e-7)
+    # final weights match the functional state
+    for attr, path in (("layers_wq", ("layers", "wq")),
+                       ("tok_embed", ("tok_embed",)),
+                       ("lm_head", ("lm_head",))):
+        ref = state.params
+        for k in path:
+            ref = ref[k]
+        got = net._reg_params[attr].data().asnumpy()
+        np.testing.assert_allclose(got, np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6, err_msg=attr)
+    # ONE program; params REALLY sharded (wq dim1 split over fsdp)
+    assert fused.num_compiles() == 1
+    wq = net._reg_params["layers_wq"].data()._data
+    assert "fsdp" in tuple(wq.sharding.spec), wq.sharding.spec
+    assert wq.sharding.shard_shape(wq.shape)[1] == wq.shape[1] // 2
+    # inference through the sharded hybridized net still works
+    with autograd.pause(train_mode=False):
+        logits = net(tok_nd)
+    assert logits.shape == (4, 32, cfg.vocab_size)
+
+
+def test_gluon_llama_generate_and_save_load(tmp_path):
+    """The Gluon surface composes: generate() (KV cache) works off the
+    block's weights, and save/load_parameters round-trips them."""
+    cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                  attn_impl="dense", remat=False)
+    net = GluonLlama(cfg)
+    net.load_pytree(llama.init_params(cfg, jax.random.PRNGKey(1)))
+    prompt = mx.nd.array(np.ones((2, 4), np.int32))
+    out = net.generate(prompt, 3)
+    assert out.shape == (2, 7)
+    f = str(tmp_path / "gl.params")
+    net.save_parameters(f)
+    net2 = GluonLlama(cfg)
+    net2.load_parameters(f)
+    out2 = net2.generate(prompt, 3)
+    np.testing.assert_array_equal(out.asnumpy(), out2.asnumpy())
