@@ -184,6 +184,65 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256, reps=3):
     return batch * new_tokens / dt
 
 
+def bench_aot8b():
+    """AOT lower+compile of the FULL llama3_8b sharded train step on
+    an 8-device virtual CPU mesh (VERDICT r2 #2): measures trace+lower
+    wall time, StableHLO size, compile time, and per-device sharded
+    state bytes. Self-provisions the mesh via re-exec (same recipe as
+    __graft_entry__.dryrun_multichip)."""
+    if len(jax.devices()) < 8 or jax.default_backend() != "cpu":
+        import ast
+        from __graft_entry__ import respawn_on_cpu_mesh
+        out = respawn_on_cpu_mesh(
+            8, "import bench; print(bench._aot8b_impl())\n",
+            capture=True)
+        return ast.literal_eval(out.strip().splitlines()[-1])
+    return _aot8b_impl()
+
+
+def _aot8b_impl():
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.models import llama
+    from mxtpu.parallel import mesh as pmesh, step as pstep
+
+    cfg = llama.CONFIGS["llama3_8b"]
+    mesh = pmesh.create_mesh(dp=1, fsdp=4, tp=2)
+    rules = llama.sharding_rules(cfg)
+    tx = optax.adamw(1e-4)
+    t0 = time.perf_counter()
+    abs_params = jax.eval_shape(lambda: llama.init_params(cfg))
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        abs_params, rules.tree_specs(abs_params),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    abs_opt = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        jax.eval_shape(tx.init, abs_params),
+        pstep.opt_state_shardings(tx, abs_params, mesh, rules))
+    abs_state = pstep.TrainState(
+        abs_params, abs_opt,
+        jax.ShapeDtypeStruct((), jnp.int32,
+                             sharding=NamedSharding(mesh, P())), ())
+    abs_batch = {"tokens": jax.ShapeDtypeStruct(
+        (4, cfg.max_seq_len), jnp.int32,
+        sharding=NamedSharding(mesh, P(("dp", "fsdp"))))}
+    step = pstep.make_train_step(llama.loss_fn(cfg), tx, mesh, rules)
+    lowered = step._jitted.lower(abs_state, abs_batch, None)
+    t_lower = time.perf_counter() - t0
+    hlo_mb = len(lowered.as_text()) / 1e6
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t1
+    state_gb = compiled.memory_analysis().argument_size_in_bytes / 1e9
+    return {"metric": "llama3_8b_aot_state_gb_per_device",
+            "value": round(state_gb, 2), "unit": "GB",
+            "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
+            "compile_s": round(t_compile, 1),
+            "mesh": "dp1_fsdp4_tp2_x8", "vs_baseline": 1.0}
+
+
 def bench_smoke_run():
     """One REAL train step on a tiny llama config — CI's bench-path
     regression check (a jit/shape break here fails bench_smoke)."""
@@ -199,11 +258,15 @@ def bench_smoke_run():
 
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if only not in ("all", "resnet", "bert", "llama", "smoke"):
+    if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b"):
         raise SystemExit(
-            f"usage: bench.py [all|resnet|bert|llama|smoke] (got {only!r})")
+            "usage: bench.py [all|resnet|bert|llama|smoke|aot8b] "
+            f"(got {only!r})")
     if only == "smoke":
         print(json.dumps(bench_smoke_run()))
+        return
+    if only == "aot8b":
+        print(json.dumps(bench_aot8b()))
         return
     extras = []
     img_s = mfu_r = 0.0
