@@ -254,8 +254,8 @@ class Block:
         all descendants). Gradient buffers are re-created ZEROED on
         the parameter's sharding — shard() is a placement change, not
         a step boundary; don't call it mid-accumulation."""
-        import jax as _jax
         from jax.sharding import NamedSharding
+        from ..parallel.sharding import global_device_put
         for p in self.collect_params().values():
             if p._data is None:
                 if p._deferred_init:
@@ -267,11 +267,11 @@ class Block:
                     "initialize() before shard()")
             sharding = NamedSharding(mesh, rules.spec(p.name))
             grad_req = p._grad_req
-            p._data._set_data(_jax.device_put(p._data._data, sharding))
+            p._data._set_data(global_device_put(p._data._data, sharding))
             if grad_req != "null":       # grads live on the same layout
                 p._data.attach_grad(grad_req)
                 p._data.grad._set_data(
-                    _jax.device_put(p._data.grad._data, sharding))
+                    global_device_put(p._data.grad._data, sharding))
             p._sharding = sharding
 
         def mark(b):

@@ -228,7 +228,8 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
     def step(*batch):
         """One fused train step; returns the loss NDArray."""
         from .. import autograd
-        batch_vals = [jax.device_put(
+        from ..parallel.sharding import global_device_put
+        batch_vals = [global_device_put(
             b._data if isinstance(b, NDArray) else jnp.asarray(b),
             bshard) for b in batch]
         live_vals = [p.data()._data for p in live]

@@ -61,11 +61,13 @@ class DistKVStore(KVStore):
     # -- jitted collective fast path (one XLA program, zero host hops) ------
     @property
     def _comm_mesh(self):
-        """One-device-per-process mesh for cross-process grad reduction.
-        (Multi-device-per-process dense training belongs on the fully
-        jitted sharded step, mxtpu.parallel.step — this mesh serves the
-        Gluon Trainer surface, where each process owns one logical copy
-        of every parameter.)"""
+        """One-device-per-process mesh for cross-process grad reduction
+        on the KVStore veneer, where each process owns one logical copy
+        of every parameter. Multi-device-per-process training — Gluon
+        or functional — belongs on a GLOBAL mesh instead:
+        ``net.shard(create_mesh(...), rules)`` + ``make_fused_step`` or
+        ``mxtpu.parallel.step`` (proven 2-process × 4-device in
+        test_tools.py::test_global_mesh_across_processes)."""
         mesh = getattr(self, "_comm_mesh_cache", None)
         if mesh is None:
             from jax.sharding import Mesh

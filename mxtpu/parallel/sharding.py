@@ -16,7 +16,28 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["P", "ShardingRules", "named", "shard_pytree", "constrain",
-           "replicated", "batch_spec", "key_str"]
+           "replicated", "batch_spec", "key_str", "global_device_put"]
+
+
+def global_device_put(arr, sharding: "NamedSharding"):
+    """device_put that also works onto a multi-process (not fully
+    addressable) mesh: global placement accepts HOST arrays, so a
+    committed device array takes a host hop first — correct under
+    SPMD, where every process holds the same values. An array that is
+    itself global already carrying the target sharding passes through;
+    re-placing a global array onto a DIFFERENT sharding has no
+    process-local path and raises with the fix."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(arr, sharding)
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        if arr.sharding == sharding:
+            return arr
+        raise ValueError(
+            "cannot re-place a global (non-addressable) array onto a "
+            f"different sharding ({arr.sharding} -> {sharding}); "
+            "rebuild it from host values on every process instead")
+    import numpy as _np
+    return jax.device_put(_np.asarray(arr), sharding)
 
 
 def named(mesh: Mesh, *spec) -> NamedSharding:
