@@ -25,6 +25,17 @@ if base.env_bool("MXNET_ENABLE_X64", False,
     import jax as _jax
     _jax.config.update("jax_enable_x64", True)
 
+# Numeric sanitizer (SURVEY §5.2; VERDICT r2 #7): the NaiveEngine
+# switch serializes dispatch but cannot see INSIDE a jitted program —
+# this can. Every jitted computation is checked for NaNs on return and,
+# on a hit, re-run op-by-op to name the producing primitive
+# (FloatingPointError). Debug tool: disables jit caching benefits.
+if base.env_bool("MXTPU_DEBUG_NANS", False,
+                 "Abort on NaN inside jitted programs, with op "
+                 "attribution (jax_debug_nans)."):
+    import jax as _jax
+    _jax.config.update("jax_debug_nans", True)
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray

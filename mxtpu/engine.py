@@ -2,12 +2,38 @@
 knobs. The ThreadedEngine's bulking (batching op pushes into one engine
 segment) maps to XLA fusion under jit — the bulk-size knobs are accepted
 and recorded for API parity; the NaiveEngine debug mode (sync after
-every op) is honored via MXNET_ENGINE_TYPE, as in the reference."""
+every op) is honored via MXNET_ENGINE_TYPE, as in the reference.
+
+The numeric sanitizer (SURVEY §5.2) goes further than NaiveEngine:
+``set_debug_nans(True)`` / ``MXTPU_DEBUG_NANS=1`` checks every jitted
+program's outputs for NaN and re-runs op-by-op to NAME the producing
+primitive — the role the reference's per-op asnumpy() debugging played,
+but working inside fused programs."""
 from __future__ import annotations
 
 import contextlib
 
-__all__ = ["bulk", "set_bulk_size"]
+__all__ = ["bulk", "set_bulk_size", "set_debug_nans", "debug_nans"]
+
+
+def set_debug_nans(enabled: bool) -> bool:
+    """Toggle the NaN sanitizer at runtime; returns the previous
+    setting. On a NaN inside any jitted program, raises
+    FloatingPointError naming the producing primitive."""
+    import jax
+    prev = bool(jax.config.jax_debug_nans)
+    jax.config.update("jax_debug_nans", bool(enabled))
+    return prev
+
+
+@contextlib.contextmanager
+def debug_nans(enabled: bool = True):
+    """Scope with the NaN sanitizer on (or off)."""
+    prev = set_debug_nans(enabled)
+    try:
+        yield
+    finally:
+        set_debug_nans(prev)
 
 _BULK_SIZE = 15
 

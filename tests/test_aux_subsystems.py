@@ -356,3 +356,55 @@ def test_contrib_text_robust_parsing_and_oov_update(tmp_path):
         emb.update_token_vectors("cat", mx.nd.array([[1.0, 2.0, 3.0]]))
     onp.testing.assert_allclose(
         emb.get_vecs_by_tokens("cat").asnumpy(), [0.0, 1.0])
+
+
+def test_debug_nans_sanitizer():
+    """SURVEY §5.2 / VERDICT r2 #7: the NaN sanitizer must surface a
+    NaN produced INSIDE a jitted program as FloatingPointError with
+    the producing primitive named — NaiveEngine alone can't see into
+    fused programs."""
+    import pytest
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import autograd, engine
+    from mxtpu.gluon import nn
+
+    net = nn.Dense(4, in_units=4, use_bias=False)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.zeros((2, 4), np.float32))
+
+    with engine.debug_nans(True):
+        # clean program passes
+        y = net(x)
+        assert np.isfinite(y.asnumpy()).all()
+        # 0/0 inside the jitted program must abort with attribution
+        with pytest.raises(FloatingPointError) as e:
+            with autograd.pause():
+                bad = net(x) / mx.nd.zeros((2, 4))
+                bad.asnumpy()
+        assert "nan" in str(e.value).lower()
+    # restored off afterwards
+    import jax
+    assert not jax.config.jax_debug_nans
+    y = (net(x) / mx.nd.zeros((2, 4))).asnumpy()   # NaN silently OK
+    assert np.isnan(y).all()
+
+
+def test_debug_nans_env_toggle():
+    """MXTPU_DEBUG_NANS=1 wires the sanitizer at import."""
+    import os
+    import subprocess
+    import sys
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import mxtpu\n"
+            "assert jax.config.jax_debug_nans\n"
+            "print('NANS_ON')\n")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "MXTPU_DEBUG_NANS": "1",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert out.returncode == 0, out.stderr[-1000:]
+    assert "NANS_ON" in out.stdout
