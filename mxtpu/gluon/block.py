@@ -426,6 +426,7 @@ class HybridBlock(Block):
             # untouched; everything else replicates. The fused train
             # step dp-shards its own batch.
             from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel.sharding import global_device_put
 
             def place(d):
                 if not isinstance(d, jax.Array):
@@ -433,7 +434,11 @@ class HybridBlock(Block):
                 s = d.sharding
                 if isinstance(s, NamedSharding) and s.mesh == mesh:
                     return d
-                return jax.device_put(
+                # global_device_put, not jax.device_put: on a
+                # multi-process global mesh a committed device-backed
+                # input would make plain device_put raise (the mesh is
+                # not fully addressable from this host).
+                return global_device_put(
                     d, NamedSharding(mesh, PartitionSpec()))
             datas = [place(d) for d in datas]
         datas += [p.data()._data for p in params]

@@ -194,13 +194,12 @@ def _attention(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
             f"{None if mesh is None else mesh.axis_names}); pass "
             "mesh= to forward/loss_fn or use 'flash'")
     if cfg.attn_impl in ("ring", "ulysses") and sp_ok:
-        from jax.experimental.shard_map import shard_map
         kernel = ring_attention if cfg.attn_impl == "ring" \
             else ulysses_attention
-        fn = shard_map(
+        fn = jax.shard_map(
             partial(kernel, axis_name="sp", causal=True),
             mesh=mesh, in_specs=(_QKV, _QKV, _QKV), out_specs=_QKV,
-            check_rep=False)
+            check_vma=False)
         return fn(q, k, v)
     if cfg.attn_impl == "dense":
         return dense_attention(q, k, v, causal=True)

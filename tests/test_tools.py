@@ -456,6 +456,8 @@ def test_global_mesh_across_processes(tmp_path):
     state = pstep.init_state(params, optax.sgd(0.1), mesh, rules)
     step = pstep.make_train_step(llama.loss_fn(cfg), optax.sgd(0.1),
                                  mesh, rules)
+    ref_eager = float(jnp.mean(llama.forward(
+        cfg, params, jnp.asarray(tokens)).astype(jnp.float32)))
     ref = []
     for _ in range(3):
         state, loss = step(state, {"tokens": jnp.asarray(tokens)})
@@ -528,6 +530,12 @@ def test_global_mesh_across_processes(tmp_path):
                            {{"learning_rate": 0.1, "wd": 0.0}})
         fused = tr.make_fused_step(net)
         tok_nd = mx.nd.array(tokens)
+        # EAGER inference through the globally-sharded net (advisor r3
+        # #2): the input is a committed process-local device array, so
+        # placement must take the global_device_put host-hop — plain
+        # device_put onto the non-addressable mesh raises.
+        y = net(tok_nd)
+        out["GEAGER"] = float(y.astype("float32").mean().asscalar())
         g_losses = [float(fused(tok_nd, tok_nd).asscalar())
                     for _ in range(3)]
         out["GGLUON"] = g_losses
@@ -552,6 +560,9 @@ def test_global_mesh_across_processes(tmp_path):
             np.testing.assert_allclose(res[tag], ref, rtol=2e-5,
                                        atol=1e-6,
                                        err_msg=f"rank{rank} {tag}")
+        np.testing.assert_allclose(res["GEAGER"], ref_eager, rtol=2e-5,
+                                   atol=1e-6,
+                                   err_msg=f"rank{rank} GEAGER")
 
 
 @pytest.mark.slow
@@ -651,6 +662,39 @@ def test_launch_mpi_rank_wrapper():
         env={**os.environ, "OMPI_COMM_WORLD_RANK": "3"})
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "3 --foo bar"
+
+
+def test_launch_ssh_secret_via_stdin(tmp_path):
+    """Advisor r3 #1: MXTPU_PS_SECRET must never appear on a command
+    line (ps / /proc/<pid>/cmdline are world-readable). The ssh
+    launcher pipes it via ssh's stdin; the remote prologue reads and
+    exports it. Verified with a fake `ssh` that logs its argv and runs
+    the remote command locally."""
+    fake = tmp_path / "ssh"
+    fake.write_text("#!/bin/bash\n"
+                    f"echo \"$@\" >> {tmp_path}/argv.log\n"
+                    "exec bash -c \"$2\"\n")
+    fake.chmod(0o755)
+    worker = tmp_path / "sec_worker.py"
+    worker.write_text(
+        "import os\n"
+        f"open(os.path.join({str(tmp_path)!r},"
+        " 'sec' + os.environ['DMLC_WORKER_ID']), 'w')"
+        ".write(os.environ.get('MXTPU_PS_SECRET', 'MISSING'))\n")
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("h0\nh1\n")
+    out = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "ssh",
+         "-H", str(hostfile), "--", sys.executable, str(worker)],
+        env={**os.environ, "PATH": f"{tmp_path}:{os.environ['PATH']}",
+             "MXTPU_PS_SECRET": "s3cr3t-r4"},
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    for rank in range(2):
+        assert (tmp_path / f"sec{rank}").read_text() == "s3cr3t-r4"
+    argv = (tmp_path / "argv.log").read_text()
+    assert "s3cr3t-r4" not in argv, "secret leaked into ssh argv"
+    assert "MXTPU_PS_SECRET=$(cat)" in argv  # stdin prologue in place
 
 
 @pytest.mark.slow

@@ -82,7 +82,6 @@ def launch_ssh(args, command):
     port = args.port or 9091
     coord = hosts[0]
     procs = []
-    import shlex
     secret = os.environ.get("MXTPU_PS_SECRET")
     for rank in range(args.num_workers):
         envs = " ".join([
@@ -91,10 +90,28 @@ def launch_ssh(args, command):
             f"DMLC_PS_ROOT_PORT={port}",
             f"DMLC_NUM_WORKER={args.num_workers}",
             f"DMLC_WORKER_ID={rank}",
-        ] + ([f"MXTPU_PS_SECRET={shlex.quote(secret)}"] if secret else [])
-          + (args.env or []))
+        ] + (args.env or []))
         cmd = f"cd {os.getcwd()} && {envs} {' '.join(command)}"
-        procs.append(subprocess.Popen(["ssh", hosts[rank], cmd]))
+        if secret:
+            # The shared secret must never appear on a command line —
+            # ps / /proc/<pid>/cmdline are world-readable on both the
+            # launching and remote hosts, which would defeat the HMAC
+            # peer auth it exists for. The remote shell reads it from
+            # ssh's stdin instead: $(cat) slurps to EOF (multi-line
+            # secrets survive; only trailing newlines are stripped),
+            # and an empty read aborts loudly rather than starting the
+            # worker unauthenticated.
+            cmd = ("MXTPU_PS_SECRET=$(cat) && "
+                   "[ -n \"$MXTPU_PS_SECRET\" ] || "
+                   "{ echo 'launch.py: no secret on stdin' >&2; "
+                   "exit 90; }; export MXTPU_PS_SECRET; " + cmd)
+            proc = subprocess.Popen(["ssh", hosts[rank], cmd],
+                                    stdin=subprocess.PIPE)
+            proc.stdin.write(secret.encode())
+            proc.stdin.close()
+        else:
+            proc = subprocess.Popen(["ssh", hosts[rank], cmd])
+        procs.append(proc)
     code = 0
     for p in procs:
         p.wait()
@@ -115,9 +132,10 @@ def _dmlc_wrapper(rank_expr, args, coord, port):
         f"export DMLC_NUM_WORKER={args.num_workers}",
         f"export DMLC_WORKER_ID={rank_expr}",
     ]
-    if os.environ.get("MXTPU_PS_SECRET"):   # auth travels with the job
-        exports.append("export MXTPU_PS_SECRET="
-                       f"{shlex.quote(os.environ['MXTPU_PS_SECRET'])}")
+    # MXTPU_PS_SECRET is deliberately NOT exported here: the wrapper
+    # string becomes a bash -c argv (visible in ps), so the secret
+    # rides the scheduler's native env forwarding instead (mpirun -x /
+    # srun --export), which passes names, not values.
     for e in (args.env or []):
         k, _, v = e.partition("=")
         exports.append(f"export {k}={shlex.quote(v)}")
@@ -131,9 +149,31 @@ def launch_mpi(args, command):
     coord = os.environ.get("MXTPU_COORD_HOST", "127.0.0.1")
     wrapper = _dmlc_wrapper(
         "${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}", args, coord, port)
-    cmd = ["mpirun", "-np", str(args.num_workers), "bash", "-c",
-           wrapper, "--"] + list(command)
+    cmd = ["mpirun", "-np", str(args.num_workers)]
+    if os.environ.get("MXTPU_PS_SECRET"):
+        cmd += _mpi_env_forward_flags()    # name only; value stays env
+    cmd += ["bash", "-c", wrapper, "--"] + list(command)
     return subprocess.call(cmd)
+
+
+def _mpi_env_forward_flags():
+    """Env-forwarding flags for the detected MPI flavor (the flag that
+    passes a variable NAME, keeping the value out of argv): OpenMPI
+    wants ``-x``; MPICH/Hydra and Intel MPI want ``-genvlist``. MPICH's
+    Hydra forwards the launching environment by default, so on an
+    unrecognized flavor we forward nothing rather than abort the job
+    with an unknown flag."""
+    try:
+        ver = subprocess.run(["mpirun", "--version"],
+                             capture_output=True, text=True,
+                             timeout=10).stdout
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if "Open MPI" in ver or "OpenRTE" in ver:
+        return ["-x", "MXTPU_PS_SECRET"]
+    if "HYDRA" in ver or "MPICH" in ver or "Intel" in ver:
+        return ["-genvlist", "MXTPU_PS_SECRET"]
+    return []
 
 
 def launch_slurm(args, command):
@@ -143,8 +183,8 @@ def launch_slurm(args, command):
                            os.environ.get("SLURM_LAUNCH_NODE_IPADDR",
                                           "127.0.0.1"))
     wrapper = _dmlc_wrapper("${SLURM_PROCID:-0}", args, coord, port)
-    cmd = ["srun", f"--ntasks={args.num_workers}", "bash", "-c",
-           wrapper, "--"] + list(command)
+    cmd = ["srun", f"--ntasks={args.num_workers}", "--export=ALL",
+           "bash", "-c", wrapper, "--"] + list(command)
     return subprocess.call(cmd)
 
 
