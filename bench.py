@@ -185,20 +185,27 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256, reps=3):
     return batch * new_tokens / dt
 
 
+def _on_cpu_mesh(impl_fn_name: str, n: int = 8):
+    """Run ``bench.<impl_fn_name>()`` on an n-device virtual CPU mesh:
+    directly when this process already is one, else via re-exec (same
+    recipe as __graft_entry__.dryrun_multichip), parsing the repr the
+    child prints as its last line."""
+    if len(jax.devices()) >= n and jax.default_backend() == "cpu":
+        return globals()[impl_fn_name]()
+    import ast
+    from __graft_entry__ import respawn_on_cpu_mesh
+    out = respawn_on_cpu_mesh(
+        n, f"import bench; print(bench.{impl_fn_name}())\n",
+        capture=True)
+    return ast.literal_eval(out.strip().splitlines()[-1])
+
+
 def bench_aot8b():
     """AOT lower+compile of the FULL llama3_8b sharded train step on
     an 8-device virtual CPU mesh (VERDICT r2 #2): measures trace+lower
     wall time, StableHLO size, compile time, and per-device sharded
-    state bytes. Self-provisions the mesh via re-exec (same recipe as
-    __graft_entry__.dryrun_multichip)."""
-    if len(jax.devices()) < 8 or jax.default_backend() != "cpu":
-        import ast
-        from __graft_entry__ import respawn_on_cpu_mesh
-        out = respawn_on_cpu_mesh(
-            8, "import bench; print(bench._aot8b_impl())\n",
-            capture=True)
-        return ast.literal_eval(out.strip().splitlines()[-1])
-    return _aot8b_impl()
+    state bytes."""
+    return _on_cpu_mesh("_aot8b_impl")
 
 
 def _aot8b_impl():
@@ -244,6 +251,85 @@ def _aot8b_impl():
             "mesh": "dp1_fsdp4_tp2_x8", "vs_baseline": 1.0}
 
 
+def bench_aot8b_decode():
+    """AOT lower+compile of sharded llama3_8b DECODE (VERDICT r3 #1):
+    the serving half of the flagship. Self-provisions the 8-device
+    virtual CPU mesh like bench_aot8b."""
+    return _on_cpu_mesh("_aot8b_decode_impl")
+
+
+def _aot8b_decode_impl(batch=8, prefill_len=2048):
+    """Serving layout: pure tp=8 (the Megatron inference layout — no
+    fsdp weight all-gather inside the latency-critical decode step),
+    bf16 weights, KV cache sharded on the kv-head axis (8 kv heads, 1
+    per device) at the full 8k context. One chip cannot serve this
+    model at all — bf16 weights alone are 16GB, the whole v5e HBM —
+    so the gates below are the per-device sharded-memory story."""
+    from dataclasses import replace
+    from functools import partial
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.models import llama
+    from mxtpu.parallel import mesh as pmesh
+
+    cfg = replace(llama.CONFIGS["llama3_8b"],
+                  param_dtype=jnp.bfloat16)
+    mesh = pmesh.create_mesh(tp=8)
+    rules = llama.sharding_rules(cfg)
+    ctx = cfg.max_seq_len
+    t0 = time.perf_counter()
+    abs_params = jax.eval_shape(lambda: llama.init_params(cfg))
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        abs_params, rules.tree_specs(abs_params),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    cspecs = llama.cache_specs(cfg, mesh, batch)
+    abs_cache = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        jax.eval_shape(lambda: llama.init_cache(cfg, batch, ctx)),
+        cspecs)
+    abs_tok = jax.ShapeDtypeStruct(
+        (batch, 1), jnp.int32, sharding=NamedSharding(mesh, P()))
+    # the cache is donated: decode must update it in place in HBM, not
+    # hold two 8k-context caches during the step
+    step = jax.jit(partial(llama.decode_step, cfg, mesh=mesh),
+                   donate_argnums=(2,))
+    lowered = step.lower(abs_params, abs_tok, abs_cache)
+    t_lower = time.perf_counter() - t0
+    hlo_mb = len(lowered.as_text()) / 1e6
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t1
+    mem = compiled.memory_analysis()
+    # argument/peak sizes are per-device; temp_size on this backend is
+    # whole-host across all partitions (the r3-gated train step shows
+    # temp=79GB with peak=args=12.05GB), so peak is the honest HBM gate
+    args_gb = mem.argument_size_in_bytes / 1e9
+    peak_gb = mem.peak_memory_in_bytes / 1e9
+
+    # prefill for the same cache layout (chunked prompts re-enter it)
+    abs_prompt = jax.ShapeDtypeStruct(
+        (batch, prefill_len), jnp.int32,
+        sharding=NamedSharding(mesh, P()))
+    pf = jax.jit(partial(llama.prefill, cfg, mesh=mesh,
+                         last_only=True),
+                 donate_argnums=(2,))
+    t2 = time.perf_counter()
+    pf_compiled = pf.lower(abs_params, abs_prompt, abs_cache).compile()
+    t_pf = time.perf_counter() - t2
+    pf_peak_gb = pf_compiled.memory_analysis().peak_memory_in_bytes / 1e9
+    return {"metric": "llama3_8b_decode_args_gb_per_device",
+            "value": round(args_gb, 2), "unit": "GB",
+            "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
+            "compile_s": round(t_compile, 1),
+            "peak_gb": round(peak_gb, 2),
+            "prefill_compile_s": round(t_pf, 1),
+            "prefill_peak_gb": round(pf_peak_gb, 2),
+            "batch": batch, "ctx": ctx, "mesh": "tp8_bf16",
+            "vs_baseline": None}
+
+
 def bench_smoke_run():
     """One REAL train step on a tiny llama config — CI's bench-path
     regression check (a jit/shape break here fails bench_smoke)."""
@@ -259,15 +345,19 @@ def bench_smoke_run():
 
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b"):
+    if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b",
+                    "aot8b_decode"):
         raise SystemExit(
-            "usage: bench.py [all|resnet|bert|llama|smoke|aot8b] "
-            f"(got {only!r})")
+            "usage: bench.py [all|resnet|bert|llama|smoke|aot8b|"
+            f"aot8b_decode] (got {only!r})")
     if only == "smoke":
         print(json.dumps(bench_smoke_run()))
         return
     if only == "aot8b":
         print(json.dumps(bench_aot8b()))
+        return
+    if only == "aot8b_decode":
+        print(json.dumps(bench_aot8b_decode()))
         return
     extras = []
     img_s = mfu_r = 0.0

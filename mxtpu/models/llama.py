@@ -40,8 +40,8 @@ from ..parallel.sharding import ShardingRules, constrain
 
 __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "loss_fn", "chunked_softmax_xent", "sharding_rules",
-           "CONFIGS", "init_cache", "prefill", "decode_step",
-           "generate"]
+           "CONFIGS", "init_cache", "cache_specs", "prefill",
+           "decode_step", "generate"]
 
 
 @dataclass(frozen=True)
@@ -384,23 +384,79 @@ def loss_fn(cfg: LlamaConfig, mesh: Optional[Mesh] = None):
 # throughout (cache sized to max_len, position as a traced scalar), so
 # the whole generate loop compiles to ONE program with a lax.scan —
 # no per-token dispatch, no dynamic shapes.
+#
+# Sharded serving (VERDICT r3 #1): at 8B scale a single chip cannot
+# hold the weights (16GB bf16 vs 16GB v5e HBM, before the cache), so
+# decode is mesh-first: pass ``mesh=`` and the cache shards over the
+# kv-head axis (tp) and the batch axis (dp/fsdp) while the params keep
+# their rule-table placement — the same Megatron layout the train step
+# uses, so a trained sharded state serves without resharding.
 
-def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
+def cache_specs(cfg: LlamaConfig, mesh: Mesh, batch_size: int):
+    """PartitionSpecs for the KV cache on ``mesh``: batch over the
+    data axes, kv heads over tp. An axis is dropped when the mesh
+    lacks it or the dim isn't divisible (tiny test configs / odd
+    batches) — a dropped axis means replication, never an error."""
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if a in mesh.axis_names and mesh.shape[a] > 1)
+    nb = 1
+    for a in batch_axes:
+        nb *= mesh.shape[a]
+    if batch_axes and batch_size % nb:
+        batch_axes = ()
+    tp = ("tp" if "tp" in mesh.axis_names
+          and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None)
+    kv = P(None, batch_axes if batch_axes else None, tp, None, None)
+    return {"k": kv, "v": kv, "pos": P()}
+
+
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
+               mesh: Optional[Mesh] = None):
     """Preallocated GQA KV cache: (L, b, n_kv_heads, max_len, hd) in
-    the compute dtype, plus the traced write position."""
+    the compute dtype, plus the traced write position. With ``mesh``
+    the cache materializes directly sharded per :func:`cache_specs` —
+    it never stages through one device (an 8B 8k-context cache is
+    larger than a v5e chip's HBM)."""
     hd = cfg.head_dim
     shape = (cfg.n_layers, batch_size, cfg.n_kv_heads, max_len, hd)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype),
-            "pos": jnp.zeros((), jnp.int32)}
+
+    def build():
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    if mesh is None:
+        return build()
+    from jax.sharding import NamedSharding
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        cache_specs(cfg, mesh, batch_size),
+        is_leaf=lambda s: isinstance(s, P))
+    return jax.jit(build, out_shardings=shardings)()
+
+
+def _mcon(mesh: Optional[Mesh], x, *spec):
+    """Sharding constraint against an EXPLICIT mesh (decode path —
+    there is no ambient ``use_mesh`` inside a caller's jit); falls back
+    to the ambient-mesh :func:`constrain` when no mesh is passed.
+    Unknown axes are filtered, so specs name the full layout and
+    smaller meshes ignore what they lack."""
+    if mesh is None:
+        return constrain(x, *spec)
+    from jax.sharding import NamedSharding
+    from ..parallel.sharding import _filter_spec
+    return lax.with_sharding_constraint(
+        x, NamedSharding(mesh, _filter_spec(P(*spec), mesh.axis_names)))
 
 
 def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
-                  x, lp, ck, cv):
+                  mesh, kvspec, x, lp, ck, cv):
     """One block over the cache. x: (b, s, dim) where s is the prompt
     length (prefill) or 1 (decode). ck/cv: (b, kvh, max_len, hd).
     Returns (x, ck, cv) with the new keys/values written at
-    [pos : pos+s]."""
+    [pos : pos+s]. ``kvspec`` is the per-layer cache PartitionSpec
+    (cache_specs minus the scanned layer dim); with a mesh the cache
+    write is pinned to it so XLA never re-lays the cache mid-scan."""
     b, s, _ = x.shape
     hd = cfg.head_dim
     dt = cfg.dtype
@@ -414,10 +470,27 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
     v = v.transpose(0, 2, 1, 3)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    # pin the head axis — the reshape/transpose chain above can lose
+    # the propagated sharding, and a lost head sharding makes the
+    # attention materialize the full cache per device. The axis comes
+    # from the cache spec (kvspec[1]) so the pin honors the same
+    # divisibility guard cache_specs applies: when tp doesn't divide
+    # the kv heads, both cache and q/k/v stay head-replicated instead
+    # of fighting each other with a per-layer reshard.
+    head_ax = kvspec[1] if kvspec is not None else None
+    q = _mcon(mesh, q, ("dp", "fsdp"), head_ax, None, None)
+    k = _mcon(mesh, k, ("dp", "fsdp"), head_ax, None, None)
+    v = _mcon(mesh, v, ("dp", "fsdp"), head_ax, None, None)
     zero = jnp.zeros((), jnp.int32)
     idx = (zero, zero, pos.astype(jnp.int32), zero)
     ck = lax.dynamic_update_slice(ck, k.astype(dt), idx)
     cv = lax.dynamic_update_slice(cv, v.astype(dt), idx)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        ck = lax.with_sharding_constraint(
+            ck, NamedSharding(mesh, kvspec))
+        cv = lax.with_sharding_constraint(
+            cv, NamedSharding(mesh, kvspec))
 
     # attend q against the whole cache, masked to the causal prefix:
     # key j visible to query i iff j <= pos + i. GQA-native: group the
@@ -435,25 +508,37 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
     o = jnp.einsum("bgrsk,bgkd->bgrsd", p, cv)
     o = o.reshape(b, cfg.n_heads, s, hd)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-    x = x + o @ lp["wo"].astype(dt)
+    x = x + _mcon(mesh, o @ lp["wo"].astype(dt),
+                  ("dp", "fsdp"), None, None)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
     up = h @ lp["w_up"].astype(dt)
-    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    x = x + _mcon(mesh, (gate * up) @ lp["w_down"].astype(dt),
+                  ("dp", "fsdp"), None, None)
     return x, ck, cv
 
 
 def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
-                    last_only: bool = False):
+                    last_only: bool = False,
+                    mesh: Optional[Mesh] = None):
     """Shared prefill/decode body: runs the stack over the cache and
     returns (logits (b, s, V) f32, new cache). ``last_only`` applies
     the lm_head to the final position only — generation never needs
-    (and must not pay for) full-prompt logits."""
+    (and must not pay for) full-prompt logits. ``mesh`` pins the cache
+    and residual-stream shardings (see ``cache_specs``); params attend
+    against the cache in their training placement, so the tp einsums
+    stay local and XLA reduces over tp exactly where the Megatron
+    layout implies."""
     b, s = tokens.shape
     max_len = cache["k"].shape[3]
     pos = cache["pos"]
     x = params["tok_embed"][tokens].astype(cfg.dtype)
+    x = _mcon(mesh, x, ("dp", "fsdp"), None, None)
+    kvspec = (cache_specs(cfg, mesh, b)["k"] if mesh is not None
+              else None)
+    if kvspec is not None:               # per-layer view: drop the
+        kvspec = P(*kvspec[1:])          # scanned leading L axis
     # rope tables for absolute positions pos..pos+s from one static
     # (max_len, hd/2) table — keeps the program shape-static
     cos_t, sin_t = rope_tables(cfg, max_len)
@@ -463,41 +548,61 @@ def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
     def body(x, xs):
         lp, ck, cv = xs
         x, ck, cv = _layer_cached(cfg, cos, sin, pos, max_len,
-                                  x, lp, ck, cv)
+                                  mesh, kvspec, x, lp, ck, cv)
         return x, (ck, cv)
 
     x, (ck, cv) = lax.scan(body, x,
                            (params["layers"], cache["k"], cache["v"]))
+    if mesh is not None:
+        # the scan re-stacks the per-layer cache; pin the stacked
+        # result or the whole cache round-trips through a replicated
+        # temp (full-cache bytes per device)
+        from jax.sharding import NamedSharding
+        full = NamedSharding(mesh, cache_specs(cfg, mesh, b)["k"])
+        ck = lax.with_sharding_constraint(ck, full)
+        cv = lax.with_sharding_constraint(cv, full)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if last_only:
         x = x[:, -1:]
     logits = jnp.einsum("bsd,dv->bsv", x,
                         _head(cfg, params).astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
+    logits = _mcon(mesh, logits, ("dp", "fsdp"), None, None)
     new_cache = {"k": ck, "v": cv, "pos": pos + s}
     return logits, new_cache
 
 
-def prefill(cfg: LlamaConfig, params, tokens, cache):
+def prefill(cfg: LlamaConfig, params, tokens, cache,
+            mesh: Optional[Mesh] = None, last_only: bool = False):
     """Run the prompt through the stack, filling the cache. Returns
-    (logits (b, s, V) f32 for every prompt position, cache)."""
-    return _forward_cached(cfg, params, tokens, cache)
+    (logits (b, s, V) f32 for every prompt position, cache). Serving
+    only consumes the final position — pass ``last_only=True`` and s=1
+    comes back; at 8B the full-prompt logits are the prefill peak
+    (8×2048×128256 f32 ≈ 8.4GB, vs ~0.004GB for the last position)."""
+    return _forward_cached(cfg, params, tokens, cache, mesh=mesh,
+                           last_only=last_only)
 
 
-def decode_step(cfg: LlamaConfig, params, token, cache):
+def decode_step(cfg: LlamaConfig, params, token, cache,
+                mesh: Optional[Mesh] = None):
     """One autoregressive step. token: (b, 1) int32. Returns
     (logits (b, V) f32 for the next position, cache)."""
-    logits, cache = _forward_cached(cfg, params, token, cache)
+    logits, cache = _forward_cached(cfg, params, token, cache,
+                                    mesh=mesh)
     return logits[:, 0], cache
 
 
 def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
              *, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None):
+             rng: Optional[jax.Array] = None,
+             mesh: Optional[Mesh] = None):
     """Autoregressive generation: prefill + a lax.scan of decode
     steps — ONE jitted program end to end when wrapped in jax.jit
     (max_new_tokens static). temperature=0 is greedy; otherwise
-    softmax sampling at the given temperature.
+    softmax sampling at the given temperature. With ``mesh`` the whole
+    loop runs sharded (cache per :func:`cache_specs`, params as
+    placed) — serving the 8B flagship needs this: its weights alone
+    exceed one v5e chip's HBM.
 
     Returns (b, prompt_len + max_new_tokens) tokens."""
     if max_new_tokens < 1:
@@ -505,9 +610,16 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
     b, s0 = prompt.shape
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # created inside the traced program: constraints (not device_put)
+    # pin it, so generate stays one jittable unit
     cache = init_cache(cfg, b, s0 + max_new_tokens)
+    if mesh is not None:
+        cache = jax.tree.map(
+            lambda l, s: lax.with_sharding_constraint(
+                l, jax.sharding.NamedSharding(mesh, s)),
+            cache, cache_specs(cfg, mesh, b))
     logits, cache = _forward_cached(cfg, params, prompt, cache,
-                                    last_only=True)
+                                    last_only=True, mesh=mesh)
 
     def sample(rng, lg):
         if temperature == 0.0:
@@ -520,7 +632,8 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
 
     def step(carry, _):
         cache, tok, rng = carry
-        logits, cache = decode_step(cfg, params, tok[:, None], cache)
+        logits, cache = decode_step(cfg, params, tok[:, None], cache,
+                                    mesh=mesh)
         rng, sub = jax.random.split(rng)
         nxt = sample(sub, logits)
         return (cache, nxt, rng), nxt

@@ -132,6 +132,59 @@ def test_llama_generate(tiny_cfg):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_llama_sharded_decode_matches_single_device(tiny_cfg):
+    """VERDICT r3 #1: the flagship's serving half on a mesh. Prefill +
+    decode with a tp/fsdp-sharded KV cache must reproduce the
+    single-device path bit-for-bit in greedy token space and to
+    float tolerance in logits; the cache must actually be sharded
+    (kv heads over tp, batch over dp/fsdp)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from jax.sharding import NamedSharding
+    from mxtpu.parallel.sharding import shard_pytree
+
+    cfg = replace(tiny_cfg, dtype=jnp.float32, remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 10), 0,
+                                cfg.vocab_size)
+    ref_tokens = jax.jit(
+        lambda p, t: llama.generate(cfg, p, t, 6))(params, prompt)
+
+    mesh = pmesh.create_mesh(dp=2, fsdp=2, tp=2)
+    rules = llama.sharding_rules(cfg)
+    sparams = shard_pytree(params, mesh, rules)
+    sprompt = jax.device_put(
+        prompt, NamedSharding(mesh, P(("dp", "fsdp"))))
+
+    # cache placement: kv heads over tp, batch over the data axes
+    kv_sharding = NamedSharding(
+        mesh, P(None, ("dp", "fsdp"), "tp", None, None))
+    cache = llama.init_cache(cfg, 4, 16, mesh=mesh)
+    assert cache["k"].sharding.is_equivalent_to(kv_sharding, 5)
+
+    # prefill + stepwise decode on the mesh == full forward logits
+    ref_logits = llama.forward(cfg, params, prompt)
+    pre, cache = jax.jit(
+        lambda p, t, c: llama.prefill(cfg, p, t, c, mesh=mesh))(
+        sparams, sprompt, cache)
+    np.testing.assert_allclose(np.asarray(pre), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    assert cache["k"].sharding.is_equivalent_to(kv_sharding, 5), \
+        "prefill lost the cache sharding"
+    step_logits, cache = jax.jit(
+        lambda p, t, c: llama.decode_step(cfg, p, t, c, mesh=mesh))(
+        sparams, sprompt[:, -1:], cache)
+    assert step_logits.shape == (4, cfg.vocab_size)
+    assert int(cache["pos"]) == 11
+
+    # one-program sharded generate == single-device generate
+    out = jax.jit(
+        lambda p, t: llama.generate(cfg, p, t, 6, mesh=mesh))(
+        sparams, sprompt)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(ref_tokens))
+
+
 def test_llama_causality(tiny_cfg):
     """Changing a future token must not change past logits."""
     cfg = replace(tiny_cfg, dtype=jnp.float32, attn_impl="dense")

@@ -107,8 +107,12 @@ def launch_ssh(args, command):
                    "exit 90; }; export MXTPU_PS_SECRET; " + cmd)
             proc = subprocess.Popen(["ssh", hosts[rank], cmd],
                                     stdin=subprocess.PIPE)
-            proc.stdin.write(secret.encode())
-            proc.stdin.close()
+            try:
+                proc.stdin.write(secret.encode())
+                proc.stdin.close()
+            except BrokenPipeError:
+                pass   # ssh died before reading (unreachable host):
+                       # its nonzero exit is reported by the wait loop
         else:
             proc = subprocess.Popen(["ssh", hosts[rank], cmd])
         procs.append(proc)
@@ -159,21 +163,29 @@ def launch_mpi(args, command):
 def _mpi_env_forward_flags():
     """Env-forwarding flags for the detected MPI flavor (the flag that
     passes a variable NAME, keeping the value out of argv): OpenMPI
-    wants ``-x``; MPICH/Hydra and Intel MPI want ``-genvlist``. MPICH's
-    Hydra forwards the launching environment by default, so on an
-    unrecognized flavor we forward nothing rather than abort the job
-    with an unknown flag."""
+    wants ``-x``; MPICH/Hydra and Intel MPI want ``-genvlist``. An
+    unrecognizable mpirun FAILS CLOSED — launching ranks silently
+    unauthenticated would undo the protection the secret exists for
+    (the ssh path's `exit 90` is the same policy)."""
     try:
         ver = subprocess.run(["mpirun", "--version"],
                              capture_output=True, text=True,
                              timeout=10).stdout
-    except (OSError, subprocess.TimeoutExpired):
-        return []
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise SystemExit(
+            f"launch.py: cannot probe mpirun --version ({e}); refusing "
+            "to launch with MXTPU_PS_SECRET set but not forwardable. "
+            "Unset the secret or use a launcher with known env "
+            "forwarding (ssh/slurm).")
     if "Open MPI" in ver or "OpenRTE" in ver:
         return ["-x", "MXTPU_PS_SECRET"]
     if "HYDRA" in ver or "MPICH" in ver or "Intel" in ver:
         return ["-genvlist", "MXTPU_PS_SECRET"]
-    return []
+    raise SystemExit(
+        "launch.py: unrecognized MPI flavor (mpirun --version says: "
+        f"{ver.splitlines()[:1]}); refusing to launch with "
+        "MXTPU_PS_SECRET set — it would not reach the workers. Use "
+        "your scheduler's env forwarding or the ssh launcher.")
 
 
 def launch_slurm(args, command):
