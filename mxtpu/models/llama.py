@@ -470,17 +470,18 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
     v = v.transpose(0, 2, 1, 3)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    # pin the head axis — the reshape/transpose chain above can lose
-    # the propagated sharding, and a lost head sharding makes the
-    # attention materialize the full cache per device. The axis comes
-    # from the cache spec (kvspec[1]) so the pin honors the same
-    # divisibility guard cache_specs applies: when tp doesn't divide
-    # the kv heads, both cache and q/k/v stay head-replicated instead
-    # of fighting each other with a per-layer reshard.
+    # pin the batch + head axes — the reshape/transpose chain above can
+    # lose the propagated sharding, and a lost head sharding makes the
+    # attention materialize the full cache per device. BOTH axes come
+    # from the cache spec (kvspec[0]/[1]) so the pins honor the same
+    # divisibility guards cache_specs applies: an odd batch or a tp
+    # that doesn't divide the kv heads replicates that axis everywhere
+    # instead of fighting the cache with a per-layer reshard.
+    batch_ax = kvspec[0] if kvspec is not None else ("dp", "fsdp")
     head_ax = kvspec[1] if kvspec is not None else None
-    q = _mcon(mesh, q, ("dp", "fsdp"), head_ax, None, None)
-    k = _mcon(mesh, k, ("dp", "fsdp"), head_ax, None, None)
-    v = _mcon(mesh, v, ("dp", "fsdp"), head_ax, None, None)
+    q = _mcon(mesh, q, batch_ax, head_ax, None, None)
+    k = _mcon(mesh, k, batch_ax, head_ax, None, None)
+    v = _mcon(mesh, v, batch_ax, head_ax, None, None)
     zero = jnp.zeros((), jnp.int32)
     idx = (zero, zero, pos.astype(jnp.int32), zero)
     ck = lax.dynamic_update_slice(ck, k.astype(dt), idx)
@@ -509,13 +510,13 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
     o = o.reshape(b, cfg.n_heads, s, hd)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
     x = x + _mcon(mesh, o @ lp["wo"].astype(dt),
-                  ("dp", "fsdp"), None, None)
+                  batch_ax, None, None)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
     up = h @ lp["w_up"].astype(dt)
     x = x + _mcon(mesh, (gate * up) @ lp["w_down"].astype(dt),
-                  ("dp", "fsdp"), None, None)
+                  batch_ax, None, None)
     return x, ck, cv
 
 
@@ -533,12 +534,13 @@ def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
     b, s = tokens.shape
     max_len = cache["k"].shape[3]
     pos = cache["pos"]
-    x = params["tok_embed"][tokens].astype(cfg.dtype)
-    x = _mcon(mesh, x, ("dp", "fsdp"), None, None)
     kvspec = (cache_specs(cfg, mesh, b)["k"] if mesh is not None
               else None)
     if kvspec is not None:               # per-layer view: drop the
         kvspec = P(*kvspec[1:])          # scanned leading L axis
+    batch_ax = kvspec[0] if kvspec is not None else ("dp", "fsdp")
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    x = _mcon(mesh, x, batch_ax, None, None)
     # rope tables for absolute positions pos..pos+s from one static
     # (max_len, hd/2) table — keeps the program shape-static
     cos_t, sin_t = rope_tables(cfg, max_len)
@@ -567,7 +569,7 @@ def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
     logits = jnp.einsum("bsd,dv->bsv", x,
                         _head(cfg, params).astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    logits = _mcon(mesh, logits, ("dp", "fsdp"), None, None)
+    logits = _mcon(mesh, logits, batch_ax, None, None)
     new_cache = {"k": ck, "v": cv, "pos": pos + s}
     return logits, new_cache
 
@@ -610,14 +612,12 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
     b, s0 = prompt.shape
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    # created inside the traced program: constraints (not device_put)
-    # pin it, so generate stays one jittable unit
-    cache = init_cache(cfg, b, s0 + max_new_tokens)
-    if mesh is not None:
-        cache = jax.tree.map(
-            lambda l, s: lax.with_sharding_constraint(
-                l, jax.sharding.NamedSharding(mesh, s)),
-            cache, cache_specs(cfg, mesh, b))
+    # init_cache(mesh=) materializes the cache directly sharded: under
+    # an outer jit the nested jit's out_shardings become constraints,
+    # and called EAGERLY (GluonLlama.generate) the full cache never
+    # stages through one device — at 8B that transient replicated
+    # cache would be 8.6GB on the default chip
+    cache = init_cache(cfg, b, s0 + max_new_tokens, mesh=mesh)
     logits, cache = _forward_cached(cfg, params, prompt, cache,
                                     last_only=True, mesh=mesh)
 
