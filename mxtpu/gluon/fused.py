@@ -384,7 +384,11 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
     def _make_jitted():
         def _step(*args):
             return _step_body(*args)
-        return jax.jit(_step, donate_argnums=(0, 1, 2),
+        # amp state (arg 2) is NOT donated: the scale scalar is shared
+        # with trainer._amp_loss_scaler.loss_scale (kept coherent for
+        # mixed classic/fused use), and donating it would invalidate
+        # the scaler's reference
+        return jax.jit(_step, donate_argnums=(0, 1),
                        out_shardings=(None, live_out_sh, state_out_sh,
                                       amp_out_sh, None))
 
@@ -411,7 +415,7 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
 
     from ..parallel.sharding import global_device_put as _gput
     box = {"jitted": _make_jitted(), "fp": _trace_fp(),
-           "past_compiles": 0,
+           "past_compiles": 0, "state_width": _state_width(optimizer),
            "amp": ({"scale": _gput(
                         jnp.asarray(scaler.loss_scale, jnp.float32),
                         repl),
@@ -429,6 +433,22 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
             # clip, a param's lr_mult...): retrace so the edit takes
             # effect — the classic path's _opt_fingerprint contract
             box["past_compiles"] += int(box["jitted"]._cache_size())
+            if _state_width(optimizer) != box["state_width"]:
+                # the edit changed the state STRUCTURE (momentum
+                # 0→nonzero, RMSProp centered flip): fresh zeroed
+                # state on the right shardings — there is no prior
+                # history for the new slots to carry. Mutate the
+                # lists in place BEFORE _make_jitted so its
+                # out_shardings closure sees the new structure.
+                box["state_width"] = _state_width(optimizer)
+                opt_states[:] = [
+                    _init_opt_state(optimizer, p, shardings[p.name])
+                    for p in live]
+                state_out_sh[:] = [
+                    None if s is None
+                    else jax.tree.map(
+                        lambda _, sh=shardings[p.name]: sh, s)
+                    for p, s in zip(live, opt_states)]
             box["jitted"] = _make_jitted()
             box["fp"] = fp
         batch_vals = [global_device_put(
@@ -450,9 +470,18 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
             "t": jnp.asarray(optimizer.num_update, jnp.int32),
         }
         key = _random._next_key()
+        amp_in = box["amp"]
+        if dynamic_amp:
+            # the live scale comes FROM the scaler each step (a device
+            # scalar stays lazy — no host sync; a classic-path edit of
+            # loss_scale is a host float and converts here), and the
+            # updated scale goes BACK to the scaler, so mixing classic
+            # and fused steps on one trainer stays coherent
+            amp_in = dict(amp_in, scale=_gput(
+                jnp.asarray(scaler.loss_scale, jnp.float32), repl))
         with use_mesh(mesh):
             loss, new_live, new_states, new_amp, aux = box["jitted"](
-                live_vals, opt_states, box["amp"], frozen_vals,
+                live_vals, opt_states, amp_in, frozen_vals,
                 batch_vals, hyper, key)
         with autograd.pause():
             for p, v in zip(live, new_live):
@@ -461,6 +490,8 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
                 frozen[i]._data._set_data(v)
         opt_states[:] = new_states
         box["amp"] = new_amp
+        if dynamic_amp:
+            scaler.loss_scale = new_amp["scale"]
         return NDArray(loss)
 
     step.num_compiles = lambda: (box["past_compiles"] +

@@ -677,13 +677,17 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
             3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
 
     def _f(x, w, *b):
+        # NO preferred_element_type here: jax's conv TRANSPOSE rule
+        # feeds the (f32) cotangent back into conv_general_dilated
+        # against the bf16 operand and dies on the dtype mismatch —
+        # mixed-precision training would break. The TPU MXU
+        # accumulates bf16 convs in f32 natively, so an explicit f32
+        # output buys no precision on the target hardware anyway.
         y = lax.conv_general_dilated(
             x, w, window_strides=stride,
             padding=[(p, p) for p in pad_],
             rhs_dilation=dilate, dimension_numbers=spec,
-            feature_group_count=num_group,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-        y = y.astype(x.dtype)
+            feature_group_count=num_group)
         if b:
             y = y + b[0].reshape((1, -1) + (1,) * nd)
         return y

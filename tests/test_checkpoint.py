@@ -71,6 +71,57 @@ def test_one_shot_save_load(tmp_path):
     onp.testing.assert_allclose(onp.asarray(back["b"]["c"]), 1.0)
 
 
+def test_sharded_save_restore_fsdp_tp(tmp_path):
+    """VERDICT r3 #5 (first half): orbax save/restore of an
+    fsdp/tp-SHARDED TrainState — the llama tiny model on an
+    fsdp2×tp2 mesh. Restore must land on the live mesh with the
+    rule-table shardings (per-shard IO, no single-device staging) and
+    the resumed trajectory must continue exactly."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from dataclasses import replace
+    from jax.sharding import NamedSharding
+    from mxtpu.models import llama
+
+    cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                  attn_impl="dense", remat=False)
+    rules = llama.sharding_rules(cfg)
+    mesh = pmesh.create_mesh(fsdp=2, tp=2, devices=jax.devices()[:4])
+    tx = optax.adamw(1e-3)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    state = pstep.init_state(params, tx, mesh, rules)
+    step = pstep.make_train_step(llama.loss_fn(cfg), tx, mesh, rules)
+    tokens = jnp.asarray(onp.random.default_rng(1).integers(
+        0, cfg.vocab_size, (4, 32)), jnp.int32)
+    for _ in range(2):
+        state, loss = step(state, {"tokens": tokens})
+
+    mgr = ckpt.CheckpointManager(str(tmp_path / "sck"),
+                                 async_save=False)
+    mgr.save(2, state)
+    mgr.wait_until_finished()
+
+    # fresh abstract state on the SAME mesh: restore must come back
+    # sharded per the rule table, not replicated
+    fresh = pstep.init_state(
+        llama.init_params(cfg, jax.random.PRNGKey(9)), tx, mesh, rules)
+    restored = mgr.restore(abstract_state=fresh)
+    wq = restored.params["layers"]["wq"]
+    assert wq.sharding == NamedSharding(mesh, rules.spec("layers/wq"))
+    assert wq.sharding.shard_shape(wq.shape) != wq.shape  # really split
+    onp.testing.assert_allclose(
+        onp.asarray(wq), onp.asarray(state.params["layers"]["wq"]),
+        rtol=1e-6)
+    # Adam moments restored sharded like their params
+    mu_wq = restored.opt_state[0].mu["layers"]["wq"]
+    assert mu_wq.sharding == wq.sharding
+
+    s_cont, l_cont = step(state, {"tokens": tokens})
+    s_res, l_res = step(restored, {"tokens": tokens})
+    onp.testing.assert_allclose(float(l_cont), float(l_res), rtol=1e-6)
+    mgr.close()
+
+
 _WORKER = """
 import os, sys
 sys.path.insert(0, {repo!r})
@@ -114,6 +165,140 @@ mgr.wait_until_finished()
 with open(out_path, "w") as f:
     f.write(repr(float(loss)))
 """
+
+
+_GMESH_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as onp
+import optax
+from mxtpu import checkpoint as ckpt
+from mxtpu.parallel import dist, mesh as pmesh, step as pstep
+from mxtpu.parallel.sharding import P, ShardingRules
+
+ckdir, total_steps, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+dist.initialize()
+rank = jax.process_index()
+assert len(jax.devices()) == 8, jax.devices()
+with open(os.path.join(outdir, f"pid{{rank}}"), "w") as f:
+    f.write(str(os.getpid()))
+
+rng = onp.random.default_rng(0)
+w1 = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+w2 = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+xs = jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)
+ys = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+
+def loss_fn(params, batch):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"])
+    return jnp.mean((h @ params["w2"] - y) ** 2)
+
+mesh = pmesh.create_mesh(fsdp=2, tp=2)   # global: 2 procs x 4 devs
+rules = ShardingRules([(r"w1", P("fsdp", "tp")),
+                       (r"w2", P("tp", None)),
+                       (r".*", P())])
+tx = optax.adam(1e-2)
+state = pstep.init_state({{"w1": w1, "w2": w2}}, tx, mesh, rules)
+step = pstep.make_train_step(loss_fn, tx, mesh, rules)
+from jax.sharding import NamedSharding
+bspec = NamedSharding(mesh, P(("dp", "fsdp")))   # train-step batch spec
+batch = (jax.device_put(xs, bspec), jax.device_put(ys, bspec))
+mgr = ckpt.CheckpointManager(ckdir, max_to_keep=3, async_save=False)
+start = mgr.latest_step()
+if start is not None:
+    state = mgr.restore(abstract_state=state)
+    start += 1
+else:
+    start = 0
+for i in range(start, total_steps):
+    state, loss = step(state, batch)
+    mgr.save(i, state)
+    mgr.wait_until_finished()
+    if rank == 0:     # progress file, not stdout: gloo noise splices
+        with open(os.path.join(outdir, "progress"), "a") as f:
+            f.write(f"STEP {{i}} {{float(jax.device_get(loss))!r}}\\n")
+mgr.wait_until_finished()
+mgr.close()
+with open(os.path.join(outdir, f"final{{rank}}.txt"), "w") as f:
+    f.write(repr(float(jax.device_get(loss))))
+dist.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_fault_injection_resume_global_mesh(tmp_path):
+    """VERDICT r3 #5 (second half): the SIGKILL harness AT SCALE — a
+    2-process × 4-device global mesh training an fsdp/tp-sharded
+    state with per-step orbax checkpoints. Kill rank 1 mid-run (the
+    launcher then takes down the survivor, as a pod scheduler would),
+    relaunch the whole job, and the resumed run must land on the
+    uninterrupted run's trajectory exactly. Also exercises orbax's
+    multi-process commit protocol: the kill window overlaps saves and
+    a torn checkpoint must never be offered for restore."""
+    launch = os.path.join(REPO, "tools", "launch.py")
+    worker = tmp_path / "gworker.py"
+    worker.write_text(_GMESH_WORKER.format(repo=REPO))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+
+    def launch_job(ckdir, outdir, steps=10, background=False):
+        os.makedirs(outdir, exist_ok=True)
+        cmd = [sys.executable, launch, "-n", "2", "--launcher", "local",
+               "--env", "JAX_PLATFORMS=cpu",
+               "XLA_FLAGS=--xla_force_host_platform_device_count=4",
+               "--", sys.executable, str(worker), ckdir, str(steps),
+               outdir]
+        if background:
+            return subprocess.Popen(cmd, env=env,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+        return subprocess.run(cmd, env=env, timeout=600,
+                              capture_output=True, text=True)
+
+    # uninterrupted reference
+    refdir = str(tmp_path / "ref")
+    r = launch_job(str(tmp_path / "ckref"), refdir)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    ref_final = float(open(os.path.join(refdir, "final0.txt")).read())
+
+    # interrupted run: SIGKILL rank 1 once rank 0 reports 4 steps
+    ckdir, outdir = str(tmp_path / "ck"), str(tmp_path / "out")
+    proc = launch_job(ckdir, outdir, background=True)
+    progress = os.path.join(outdir, "progress")
+    deadline = time.time() + 480
+    while time.time() < deadline:
+        if os.path.exists(progress) and \
+                sum(1 for _ in open(progress)) >= 4:
+            break
+        if proc.poll() is not None:
+            raise AssertionError("job exited before reaching 4 steps")
+        time.sleep(0.3)
+    else:
+        proc.kill()
+        raise AssertionError("job stalled before 4 steps")
+    victim = int(open(os.path.join(outdir, "pid1")).read())
+    os.kill(victim, signal.SIGKILL)
+    proc.wait(timeout=120)
+    assert proc.returncode != 0               # the job really died
+    assert not os.path.exists(os.path.join(outdir, "final1.txt"))
+
+    # relaunch the whole job: restores from the latest COMMITTED
+    # checkpoint and finishes with the uninterrupted trajectory
+    r = launch_job(ckdir, outdir)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    steps_seen = [int(l.split()[1]) for l in open(progress)
+                  if l.startswith("STEP")]
+    assert steps_seen.count(0) == 1, \
+        f"relaunch restarted from scratch: {steps_seen}"
+    assert steps_seen[-1] == 9
+    for rank in range(2):
+        final = float(open(os.path.join(
+            outdir, f"final{rank}.txt")).read())
+        assert abs(final - ref_final) < 1e-6, (rank, final, ref_final)
 
 
 @pytest.mark.slow

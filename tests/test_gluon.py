@@ -95,6 +95,32 @@ def test_conv2d():
     assert out2.shape == (2, 4, 7, 7)
 
 
+def test_conv2d_bf16_backward_through_f32_batchnorm():
+    """Mixed-precision conv backward (AMP's shape): bf16 conv feeding
+    an f32-param BatchNorm must produce bf16 grads. Regression for the
+    conv op's preferred_element_type=f32, whose jax transpose rule
+    fed the f32 cotangent back into a bf16 conv and crashed."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, in_channels=2),
+            nn.BatchNorm(in_channels=4))
+    net.initialize()
+    for p in net.collect_params().values():
+        if "batchnorm" not in p.name:
+            p.cast("bfloat16")
+    x = mx.nd.array(np.random.randn(2, 2, 8, 8)).astype("bfloat16")
+    with autograd.record():
+        loss = net(x).astype("float32").sum()
+    loss.backward()
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            g = p.grad()
+            assert bool(np.isfinite(
+                g.asnumpy().astype(np.float64)).all()), p.name
+    conv_w = [p for p in net.collect_params().values()
+              if p.name.endswith("weight")][0]
+    assert str(conv_w.grad().dtype) == "bfloat16"
+
+
 @with_seed()
 def test_pool_layers():
     x = mx.nd.array(np.random.randn(2, 3, 8, 8))

@@ -309,6 +309,10 @@ def test_fused_step_amp_dynamic_loss_scaling():
     # fused scale is a device f32; classic is a Python float
     assert fused.loss_scale() == pytest.approx(5e37, rel=1e-6)
     assert tr_c._amp_loss_scaler.loss_scale == pytest.approx(5e37)
+    # the fused trainer's own scaler object stays coherent (mixed
+    # classic/fused use reads the live scale)
+    assert float(tr_f._amp_loss_scaler.loss_scale) == \
+        pytest.approx(5e37, rel=1e-6)
     assert fused.applied_updates() == 3
     assert got[1] == pytest.approx(got[0], rel=1e-6)   # step 1 skipped
     assert got[3] < got[1]                             # then it trains
@@ -361,6 +365,47 @@ def test_fused_step_hyperparam_fingerprint_retrace():
     # exactly one retrace: 2 programs total, and lr edits alone never
     # retrace (covered by test_fused_step_matches_classic_trainer)
     assert fused.num_compiles() == 2
+
+
+def test_fused_step_retrace_handles_state_width_change():
+    """Mutating an attr that changes the optimizer-state STRUCTURE
+    (momentum 0→nonzero) must re-create zeroed state, not crash the
+    retrace — and then match a classic run making the same edit."""
+    rng = np.random.default_rng(11)
+    X = mx.nd.array(rng.standard_normal((32, 16)).astype(np.float32))
+    Y = mx.nd.array(rng.standard_normal((32, 8)).astype(np.float32))
+    opt_args = {"learning_rate": 0.05, "momentum": 0.0}
+
+    net_c, net_f = _dense_net(), _dense_net()
+    _copy_net(net_c, net_f)
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd", dict(opt_args))
+    # classic with momentum flipped on mid-run: the updater keeps a
+    # stale None state, so recreate it the way the fused path does
+    for i in range(4):
+        if i == 2:
+            tr_c._optimizer.momentum = 0.9
+            tr_c._updaters[0].states.clear()
+        with autograd.record():
+            loss = ((net_c(X) - Y) ** 2).mean()
+        loss.backward()
+        tr_c.step(1)
+
+    mesh = pmesh.create_mesh(dp=-1)
+    net_f.hybridize()
+    net_f.shard(mesh, ShardingRules([(r".*", P())]))
+    tr_f = gluon.Trainer(net_f.collect_params(), "sgd", dict(opt_args))
+    fused = tr_f.make_fused_step(
+        net_f, loss_fn=lambda out: ((out - Y) ** 2).mean())
+    for i in range(4):
+        if i == 2:
+            tr_f._optimizer.momentum = 0.9
+        fused(X)
+    assert all(s is not None for s in fused._opt_states)
+    for pc, pf in zip(net_c.collect_params().values(),
+                      net_f.collect_params().values()):
+        np.testing.assert_allclose(
+            pc.data().asnumpy(), pf.data().asnumpy(),
+            rtol=1e-5, atol=1e-6, err_msg=pc.name)
 
 
 def test_gluon_llama_ring_attention_on_sp_mesh():

@@ -616,7 +616,10 @@ def test_example_scripts_smoke():
     """New example suites run end-to-end on the CPU mesh."""
     for script in ("example/autograd/custom_function.py",
                    "example/kvstore/async_ps.py",
-                   "example/pipeline_parallel/gpipe_demo.py"):
+                   "example/pipeline_parallel/gpipe_demo.py",
+                   "example/ssd/train_ssd.py",
+                   "example/rnn/bucketing/bucketing_lstm.py",
+                   "example/amp/train_amp.py"):
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, script)],
             capture_output=True, text=True, timeout=300,
