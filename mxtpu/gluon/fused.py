@@ -472,13 +472,18 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
         key = _random._next_key()
         amp_in = box["amp"]
         if dynamic_amp:
-            # the live scale comes FROM the scaler each step (a device
-            # scalar stays lazy — no host sync; a classic-path edit of
-            # loss_scale is a host float and converts here), and the
-            # updated scale goes BACK to the scaler, so mixing classic
-            # and fused steps on one trainer stays coherent
-            amp_in = dict(amp_in, scale=_gput(
-                jnp.asarray(scaler.loss_scale, jnp.float32), repl))
+            # the live scale AND clean-step counter come FROM the
+            # scaler each step (device scalars stay lazy — no host
+            # sync; a classic-path edit is a host value and converts
+            # here), and both go BACK after, so mixing classic and
+            # fused steps on one trainer keeps the whole
+            # halve/grow-window policy coherent, not just the scale
+            amp_in = dict(
+                amp_in,
+                scale=_gput(jnp.asarray(scaler.loss_scale,
+                                        jnp.float32), repl),
+                unskipped=_gput(jnp.asarray(scaler._unskipped,
+                                            jnp.int32), repl))
         with use_mesh(mesh):
             loss, new_live, new_states, new_amp, aux = box["jitted"](
                 live_vals, opt_states, amp_in, frozen_vals,
@@ -492,6 +497,7 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
         box["amp"] = new_amp
         if dynamic_amp:
             scaler.loss_scale = new_amp["scale"]
+            scaler._unskipped = new_amp["unskipped"]
         return NDArray(loss)
 
     step.num_compiles = lambda: (box["past_compiles"] +

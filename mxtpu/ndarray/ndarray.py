@@ -239,12 +239,27 @@ class NDArray:
         self._ag = None
         self._version += 1
 
+    @staticmethod
+    def _norm_key(key):
+        """NumPy accepts plain lists as advanced indices (``x[[0, 2]]``,
+        ``x[1, :, [0, 4]]``); jax insists on arrays — normalize."""
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, list):
+            return jnp.asarray(key)
+        if isinstance(key, tuple):
+            return tuple(
+                k._data if isinstance(k, NDArray)
+                else jnp.asarray(k) if isinstance(k, list) else k
+                for k in key)
+        return key
+
     def __setitem__(self, key, value) -> None:
         if isinstance(value, NDArray):
             value = value._data
-        if isinstance(key, NDArray):
-            key = key._data
-        if key is None or key == slice(None) or key is Ellipsis:
+        key = self._norm_key(key)
+        if key is None or key is Ellipsis or \
+                (isinstance(key, slice) and key == slice(None)):
             if _np.isscalar(value):
                 self._set_data(jnp.full(self.shape, value, self._data.dtype))
             else:
@@ -254,8 +269,7 @@ class NDArray:
             self._set_data(self._data.at[key].set(value))
 
     def __getitem__(self, key) -> "NDArray":
-        if isinstance(key, NDArray):
-            key = key._data
+        key = self._norm_key(key)
         return apply_op(lambda x: x[key], [self], "getitem")
 
     # -- autograd -----------------------------------------------------------

@@ -95,6 +95,67 @@ class ndarray(NDArray):
         return apply_op(lambda x: jnp.any(x, axis=axis, keepdims=keepdims),
                         [self], "any")
 
+    def ravel(self, order="C"):
+        if order != "C":
+            raise NotImplementedError(
+                f"ravel(order={order!r}): only C order is supported "
+                "(XLA arrays are row-major)")
+        return apply_op(lambda x: jnp.ravel(x), [self], "ravel")
+
+    def flatten(self, order="C"):
+        # numpy's flatten always copies; functional buffers make every
+        # result independent anyway
+        return self.ravel(order)
+
+    def take(self, indices, axis=None, mode="clip"):
+        if mode not in ("clip", "wrap"):
+            raise NotImplementedError(
+                f"take(mode={mode!r}): XLA gathers cannot raise on "
+                "out-of-range indices; use 'clip' (default) or 'wrap'")
+        idx = indices._data if isinstance(indices, NDArray) else indices
+        return apply_op(
+            lambda x: jnp.take(x, jnp.asarray(idx), axis=axis,
+                               mode=mode),
+            [self], "take")
+
+    def repeat(self, repeats, axis=None):
+        return apply_op(
+            lambda x: jnp.repeat(x, repeats, axis=axis), [self], "repeat")
+
+    def cumsum(self, axis=None, dtype=None):
+        return apply_op(
+            lambda x: jnp.cumsum(x, axis=axis, dtype=dtype),
+            [self], "cumsum")
+
+    def cumprod(self, axis=None, dtype=None):
+        return apply_op(
+            lambda x: jnp.cumprod(x, axis=axis, dtype=dtype),
+            [self], "cumprod")
+
+    def round(self, decimals=0):
+        return apply_op(lambda x: jnp.round(x, decimals), [self], "round")
+
+    def clip(self, min=None, max=None):
+        return apply_op(lambda x: jnp.clip(x, min, max), [self], "clip")
+
+    def sort(self, axis=-1):
+        return apply_op(lambda x: jnp.sort(x, axis=axis), [self], "sort")
+
+    def argsort(self, axis=-1):
+        return apply_op(lambda x: jnp.argsort(x, axis=axis), [self],
+                        "argsort")
+
+    def nonzero(self):
+        return tuple(ndarray(v) for v in jnp.nonzero(self._data))
+
+    def squeeze(self, axis=None):
+        return apply_op(lambda x: jnp.squeeze(x, axis=axis), [self],
+                        "squeeze")
+
+    def swapaxes(self, axis1, axis2):
+        return apply_op(lambda x: jnp.swapaxes(x, axis1, axis2),
+                        [self], "swapaxes")
+
 
 def from_nd(a: NDArray) -> ndarray:
     """View an mx.nd array as mx.np (shares buffer, tape link, and grad

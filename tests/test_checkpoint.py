@@ -77,8 +77,8 @@ def test_sharded_save_restore_fsdp_tp(tmp_path):
     fsdp2×tp2 mesh. Restore must land on the live mesh with the
     rule-table shardings (per-shard IO, no single-device staging) and
     the resumed trajectory must continue exactly."""
-    if len(jax.devices()) < 8:
-        pytest.skip("needs 8 (virtual) devices")
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 (virtual) devices")
     from dataclasses import replace
     from jax.sharding import NamedSharding
     from mxtpu.models import llama
