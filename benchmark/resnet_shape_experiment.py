@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""The ResNet-MFU experiment perf.md round-3 hypothesized (VERDICT r3
+#7): ResNet-50 parks at ~27% MFU because its conv output-channel
+counts sit on the slow side of this chip's matmul-N roofline. Two
+measured probes:
+
+1. **Channel-fattened variant**: the same train step with width=128
+   (wide-ResNet-50-2) — every conv's N doubles. If MFU rises, the
+   shape hypothesis is confirmed and "go wide" is the lever.
+2. **Pallas conv spike**: a custom kernel for the representative
+   3×3/14×14/256ch stage, building im2col patches IN VMEM (never
+   materialized to HBM) and hitting the MXU with one K=2304 matmul
+   per (batch, row-block) grid cell — against XLA's native conv.
+
+Honest measurement per docs/perf.md: one jitted program per probe,
+in-program lax.fori_loop where applicable, host readback fence, and
+XLA cost_analysis FLOPs (not analytic guesses) for MFU.
+
+Usage: python benchmark/resnet_shape_experiment.py [--quick]
+"""
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+V5E_PEAK = 197e12
+
+
+def measure_train(cfg_name, width, batch, steps=20):
+    from dataclasses import replace
+    from mxtpu.models import resnet
+    from mxtpu.parallel import mesh as pmesh, step as pstep
+    from mxtpu.parallel.sharding import ShardingRules, P
+
+    cfg = replace(resnet.CONFIGS["resnet50"], width=width)
+    mesh = pmesh.create_mesh(dp=-1)
+    rules = ShardingRules([(r".*", P())])
+    params = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = pstep.init_state(params, tx, mesh, rules,
+                             model_state=resnet.init_state(cfg))
+    train_step = pstep.make_train_step(
+        resnet.loss_fn(cfg), tx, mesh, rules, has_state=True)
+    rng = np.random.default_rng(0)
+    batch_d = {"image": jnp.asarray(
+                   rng.standard_normal((batch, 224, 224, 3), np.float32),
+                   jnp.bfloat16),
+               "label": jnp.asarray(rng.integers(0, 1000, batch),
+                                    jnp.int32)}
+    # authoritative FLOPs from the compiled program itself
+    compiled = train_step._jitted.lower(state, batch_d, None).compile()
+    flops = compiled.cost_analysis()["flops"]
+    state, loss = train_step(state, batch_d)     # compile+warm
+    state, loss = train_step(state, batch_d)
+    float(jax.device_get(loss))                  # fence
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = train_step(state, batch_d)
+    float(jax.device_get(loss))                  # honest fence
+    dt = (time.perf_counter() - t0) / steps
+    tflops = flops / dt / 1e12
+    return {"name": cfg_name, "img_s": batch / dt,
+            "step_ms": dt * 1e3, "tflops": tflops,
+            "mfu": tflops * 1e12 / V5E_PEAK,
+            "program_gflop": flops / 1e9}
+
+
+# ---------------------------------------------------------------------------
+# Pallas conv spike: 3x3 SAME conv, NHWC, building the im2col patch
+# matrix in VMEM per grid cell
+# ---------------------------------------------------------------------------
+def pallas_conv3x3(x, w):
+    """x: (B, H, W, C) bf16, w: (3, 3, C, O) bf16 -> (B, H, W, O).
+    Grid over batch; each cell loads its (H+2, W+2, C) halo slab into
+    VMEM, assembles (H*W, 9C) patches with static slices, and runs ONE
+    MXU matmul against the (9C, O) reshaped filter."""
+    from jax.experimental import pallas as pl
+
+    B, H, W, C = x.shape
+    O = w.shape[-1]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    wm = w.reshape(9 * C, O)
+
+    def kernel(x_ref, w_ref, o_ref):
+        slab = x_ref[0]                          # (H+2, W+2, C)
+        cols = []
+        for dy in range(3):
+            for dx in range(3):
+                cols.append(slab[dy:dy + H, dx:dx + W, :]
+                            .reshape(H * W, C))
+        patches = jnp.concatenate(cols, axis=1)  # (H*W, 9C)
+        acc = jnp.dot(patches, w_ref[...],
+                      preferred_element_type=jnp.float32)
+        o_ref[0] = acc.astype(o_ref.dtype).reshape(H, W, O)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[pl.BlockSpec((1, H + 2, W + 2, C),
+                               lambda b: (b, 0, 0, 0)),
+                  pl.BlockSpec((9 * C, O), lambda b: (0, 0))],
+        out_specs=pl.BlockSpec((1, H, W, O), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, W, O), x.dtype),
+    )(xp, wm)
+
+
+def measure_conv(fn, x, w, reps=200, tag=""):
+    f = jax.jit(lambda x, w: fn(x, w))
+    out = f(x, w)
+    out.block_until_ready()
+    float(jax.device_get(out.reshape(-1)[0]))    # fence
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(x, w)
+    float(jax.device_get(out.reshape(-1)[0]))
+    dt = (time.perf_counter() - t0) / reps
+    B, H, W, C = x.shape
+    O = w.shape[-1]
+    flops = 2 * B * H * W * 9 * C * O
+    return {"tag": tag, "ms": dt * 1e3, "tflops": flops / dt / 1e12}
+
+
+def native_conv3x3(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    args = p.parse_args()
+    steps = 8 if args.quick else 20
+
+    print("== probe 2: Pallas conv spike (b128, 14x14, 256->256) ==",
+          flush=True)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (128, 14, 14, 256), jnp.bfloat16)
+    w = jax.random.normal(rng, (3, 3, 256, 256), jnp.bfloat16) * 0.05
+    nat = measure_conv(native_conv3x3, x, w, tag="xla native")
+    print(f"  {nat['tag']}: {nat['ms']:.3f} ms, {nat['tflops']:.1f} "
+          "TFLOP/s", flush=True)
+    try:
+        ref = np.asarray(native_conv3x3(x, w), np.float32)
+        got = np.asarray(pallas_conv3x3(x, w), np.float32)
+        err = np.abs(ref - got).max() / max(np.abs(ref).max(), 1e-6)
+        pal = measure_conv(pallas_conv3x3, x, w, tag="pallas im2col")
+        print(f"  {pal['tag']}: {pal['ms']:.3f} ms, "
+              f"{pal['tflops']:.1f} TFLOP/s (rel err {err:.2e})",
+              flush=True)
+    except Exception as e:
+        print(f"  pallas kernel failed: {type(e).__name__}: {e}",
+              flush=True)
+
+    print("== probe 1: channel-fattened train step ==", flush=True)
+    for name, width, batch in (("resnet50 (width 64)", 64, 256),
+                               ("wide-50-2 (width 128)", 128, 128)):
+        r = measure_train(name, width, batch, steps=steps)
+        print(f"  {r['name']}: {r['img_s']:.0f} img/s, "
+              f"{r['tflops']:.1f} TFLOP/s, MFU {r['mfu']:.3f} "
+              f"({r['program_gflop']:.0f} GFLOP/step)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -242,15 +242,20 @@ class NDArray:
     @staticmethod
     def _norm_key(key):
         """NumPy accepts plain lists as advanced indices (``x[[0, 2]]``,
-        ``x[1, :, [0, 4]]``); jax insists on arrays — normalize."""
+        ``x[1, :, [0, 4]]``); jax insists on arrays — normalize. An
+        EMPTY list must become an int indexer (jnp.asarray([]) is
+        float32, which jax rejects; numpy's x[[]] selects nothing)."""
+        def as_idx(seq):
+            a = jnp.asarray(seq)
+            return a.astype(jnp.int32) if a.size == 0 else a
         if isinstance(key, NDArray):
             return key._data
         if isinstance(key, list):
-            return jnp.asarray(key)
+            return as_idx(key)
         if isinstance(key, tuple):
             return tuple(
                 k._data if isinstance(k, NDArray)
-                else jnp.asarray(k) if isinstance(k, list) else k
+                else as_idx(k) if isinstance(k, list) else k
                 for k in key)
         return key
 

@@ -139,7 +139,10 @@ class ndarray(NDArray):
         return apply_op(lambda x: jnp.clip(x, min, max), [self], "clip")
 
     def sort(self, axis=-1):
-        return apply_op(lambda x: jnp.sort(x, axis=axis), [self], "sort")
+        # numpy's METHOD contract: sort in place, return None (the
+        # module function mnp.sort returns a sorted copy). In-place =
+        # rebind, so under autograd.record this raises like any write.
+        self._set_data(jnp.sort(self._data, axis=axis))
 
     def argsort(self, axis=-1):
         return apply_op(lambda x: jnp.argsort(x, axis=axis), [self],
@@ -283,6 +286,13 @@ def __getattr__(name):
                              f"{name!r}")
 
     def fn(*args, **kwargs):
+        if name == "clip":
+            # numpy's a_min/a_max spelling; jax deprecated the aliases
+            # (a TypeError on a future upgrade) — translate here
+            for old, new in (("a_min", "min"), ("a_max", "max"),
+                             ("a", "x")):
+                if old in kwargs:
+                    kwargs[new] = kwargs.pop(old)
         out = _invoke(jfn, name, args, kwargs)
         if isinstance(out, tuple):
             return tuple(o if isinstance(o, ndarray) else
