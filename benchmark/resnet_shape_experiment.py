@@ -77,37 +77,41 @@ def measure_train(cfg_name, width, batch, steps=20):
 # Pallas conv spike: 3x3 SAME conv, NHWC, building the im2col patch
 # matrix in VMEM per grid cell
 # ---------------------------------------------------------------------------
-def pallas_conv3x3(x, w):
+def pallas_conv3x3(x, w, images_per_cell: int = 1):
     """x: (B, H, W, C) bf16, w: (3, 3, C, O) bf16 -> (B, H, W, O).
-    Grid over batch; each cell loads its (H+2, W+2, C) halo slab into
-    VMEM, assembles (H*W, 9C) patches with static slices, and runs ONE
-    MXU matmul against the (9C, O) reshaped filter."""
+    Grid over batch groups of ``images_per_cell``; each cell loads its
+    (nb, H+2, W+2, C) halo slab into VMEM, assembles (nb*H*W, 9C)
+    patches with static slices, and runs ONE MXU matmul against the
+    (9C, O) reshaped filter. More images per cell fattens the matmul M
+    (the measured best on v5e is 4 — see docs/perf.md)."""
     from jax.experimental import pallas as pl
 
     B, H, W, C = x.shape
+    nb = images_per_cell
+    assert B % nb == 0
     O = w.shape[-1]
     xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
     wm = w.reshape(9 * C, O)
 
     def kernel(x_ref, w_ref, o_ref):
-        slab = x_ref[0]                          # (H+2, W+2, C)
-        cols = []
-        for dy in range(3):
-            for dx in range(3):
-                cols.append(slab[dy:dy + H, dx:dx + W, :]
-                            .reshape(H * W, C))
-        patches = jnp.concatenate(cols, axis=1)  # (H*W, 9C)
+        rows = []
+        for b in range(nb):
+            slab = x_ref[b]                      # (H+2, W+2, C)
+            cols = [slab[dy:dy + H, dx:dx + W, :].reshape(H * W, C)
+                    for dy in range(3) for dx in range(3)]
+            rows.append(jnp.concatenate(cols, axis=1))
+        patches = jnp.concatenate(rows, axis=0)  # (nb*H*W, 9C)
         acc = jnp.dot(patches, w_ref[...],
                       preferred_element_type=jnp.float32)
-        o_ref[0] = acc.astype(o_ref.dtype).reshape(H, W, O)
+        o_ref[...] = acc.astype(o_ref.dtype).reshape(nb, H, W, O)
 
     return pl.pallas_call(
         kernel,
-        grid=(B,),
-        in_specs=[pl.BlockSpec((1, H + 2, W + 2, C),
-                               lambda b: (b, 0, 0, 0)),
-                  pl.BlockSpec((9 * C, O), lambda b: (0, 0))],
-        out_specs=pl.BlockSpec((1, H, W, O), lambda b: (b, 0, 0, 0)),
+        grid=(B // nb,),
+        in_specs=[pl.BlockSpec((nb, H + 2, W + 2, C),
+                               lambda g: (g, 0, 0, 0)),
+                  pl.BlockSpec((9 * C, O), lambda g: (0, 0))],
+        out_specs=pl.BlockSpec((nb, H, W, O), lambda g: (g, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, W, O), x.dtype),
     )(xp, wm)
 
@@ -148,17 +152,20 @@ def main():
     nat = measure_conv(native_conv3x3, x, w, tag="xla native")
     print(f"  {nat['tag']}: {nat['ms']:.3f} ms, {nat['tflops']:.1f} "
           "TFLOP/s", flush=True)
-    try:
-        ref = np.asarray(native_conv3x3(x, w), np.float32)
-        got = np.asarray(pallas_conv3x3(x, w), np.float32)
-        err = np.abs(ref - got).max() / max(np.abs(ref).max(), 1e-6)
-        pal = measure_conv(pallas_conv3x3, x, w, tag="pallas im2col")
-        print(f"  {pal['tag']}: {pal['ms']:.3f} ms, "
-              f"{pal['tflops']:.1f} TFLOP/s (rel err {err:.2e})",
-              flush=True)
-    except Exception as e:
-        print(f"  pallas kernel failed: {type(e).__name__}: {e}",
-              flush=True)
+    ref = np.asarray(native_conv3x3(x, w), np.float32)
+    for nb in (1, 2, 4, 8):
+        try:
+            fn = functools.partial(pallas_conv3x3, images_per_cell=nb)
+            got = np.asarray(fn(x, w), np.float32)
+            err = np.abs(ref - got).max() / max(np.abs(ref).max(),
+                                                1e-6)
+            pal = measure_conv(fn, x, w, tag=f"pallas im2col nb={nb}")
+            print(f"  {pal['tag']}: {pal['ms']:.3f} ms, "
+                  f"{pal['tflops']:.1f} TFLOP/s (rel err {err:.2e})",
+                  flush=True)
+        except Exception as e:
+            print(f"  pallas nb={nb} failed: {type(e).__name__}: {e}",
+                  flush=True)
 
     print("== probe 1: channel-fattened train step ==", flush=True)
     for name, width, batch in (("resnet50 (width 64)", 64, 256),

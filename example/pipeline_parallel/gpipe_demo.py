@@ -27,7 +27,7 @@ def main():
     cos, sin = llama.rope_tables(cfg, 32)
 
     def layer_fn(lp, xx):
-        return llama._layer(cfg, None, cos, sin, xx, lp)
+        return llama._layer(cfg, None, cos, sin, xx, lp)[0]
 
     mesh = pmesh.create_mesh(dp=1, pp=2, devices=jax.devices()[:2])
     out = jax.jit(lambda lp, xx: gpipe(layer_fn, lp, xx, mesh=mesh,

@@ -30,7 +30,9 @@ from ..parameter import Parameter
 __all__ = ["GluonLlama"]
 
 
-# attribute-safe alias ↔ functional pytree path
+# attribute-safe alias ↔ functional pytree path (entries absent from a
+# config's param tree — lm_head under tied embeddings, moe_gate for
+# dense FFNs — are filtered at construction)
 _PARAM_PATHS = {
     "tok_embed": ("tok_embed",),
     "layers_attn_norm": ("layers", "attn_norm"),
@@ -39,12 +41,26 @@ _PARAM_PATHS = {
     "layers_wv": ("layers", "wv"),
     "layers_wo": ("layers", "wo"),
     "layers_ffn_norm": ("layers", "ffn_norm"),
+    "layers_moe_gate": ("layers", "moe_gate"),
     "layers_w_gate": ("layers", "w_gate"),
     "layers_w_up": ("layers", "w_up"),
     "layers_w_down": ("layers", "w_down"),
     "final_norm": ("final_norm",),
     "lm_head": ("lm_head",),
 }
+
+
+def _present(paths, tree):
+    out = {}
+    for attr, path in paths.items():
+        leaf = tree
+        try:
+            for k in path:
+                leaf = leaf[k]
+        except (KeyError, TypeError):
+            continue
+        out[attr] = path
+    return out
 
 
 class GluonLlama(HybridBlock):
@@ -73,10 +89,7 @@ class GluonLlama(HybridBlock):
         self._cfg = cfg
         abs_params = jax.eval_shape(
             lambda: _fl.init_params(cfg, jax.random.PRNGKey(0)))
-        paths = dict(_PARAM_PATHS)
-        if cfg.tie_embeddings:
-            paths.pop("lm_head")
-        for attr, path in paths.items():
+        for attr, path in _present(_PARAM_PATHS, abs_params).items():
             leaf = abs_params
             for k in path:
                 leaf = leaf[k]

@@ -70,6 +70,13 @@ class LlamaConfig:
     # logits would dominate HBM, i.e. vocab > 16384), None/False =
     # always materialize full logits, int = explicit chunk width
     ce_chunk: Optional[int] = 0
+    # Mixture-of-Experts FFN (expert parallelism over the mesh 'ep'
+    # axis): 0 = dense FFN; >0 replaces every layer's FFN with that
+    # many SwiGLU experts (parallel.moe)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -97,12 +104,12 @@ CONFIGS: Dict[str, LlamaConfig] = {
 def _init_layer(key, cfg: LlamaConfig, n: int):
     """Stacked params for n layers (leading dim = layer index)."""
     hd = cfg.head_dim
-    ks = jax.random.split(key, 7)
+    ks = jax.random.split(key, 8)
     d = cfg.param_dtype
     # small-init (scaled by fan-in) — GPT-2/Llama style
     def init(k, shape, fan_in):
         return (jax.random.normal(k, shape, d) / math.sqrt(fan_in))
-    return {
+    out = {
         "attn_norm": jnp.ones((n, cfg.dim), d),
         "wq": init(ks[0], (n, cfg.dim, cfg.n_heads * hd), cfg.dim),
         "wk": init(ks[1], (n, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
@@ -110,11 +117,23 @@ def _init_layer(key, cfg: LlamaConfig, n: int):
         "wo": init(ks[3], (n, cfg.n_heads * hd, cfg.dim),
                    cfg.n_heads * hd * 2 * cfg.n_layers),
         "ffn_norm": jnp.ones((n, cfg.dim), d),
-        "w_gate": init(ks[4], (n, cfg.dim, cfg.hidden_dim), cfg.dim),
-        "w_up": init(ks[5], (n, cfg.dim, cfg.hidden_dim), cfg.dim),
-        "w_down": init(ks[6], (n, cfg.hidden_dim, cfg.dim),
-                       cfg.hidden_dim * 2 * cfg.n_layers),
     }
+    E = cfg.moe_experts
+    if E:
+        out["moe_gate"] = init(ks[7], (n, cfg.dim, E), cfg.dim)
+        out["w_gate"] = init(ks[4], (n, E, cfg.dim, cfg.hidden_dim),
+                             cfg.dim)
+        out["w_up"] = init(ks[5], (n, E, cfg.dim, cfg.hidden_dim),
+                           cfg.dim)
+        out["w_down"] = init(ks[6], (n, E, cfg.hidden_dim, cfg.dim),
+                             cfg.hidden_dim * 2 * cfg.n_layers)
+    else:
+        out["w_gate"] = init(ks[4], (n, cfg.dim, cfg.hidden_dim),
+                             cfg.dim)
+        out["w_up"] = init(ks[5], (n, cfg.dim, cfg.hidden_dim), cfg.dim)
+        out["w_down"] = init(ks[6], (n, cfg.hidden_dim, cfg.dim),
+                             cfg.hidden_dim * 2 * cfg.n_layers)
+    return out
 
 
 def init_params(cfg: LlamaConfig, rng: Optional[jax.Array] = None):
@@ -140,14 +159,20 @@ def sharding_rules(cfg: Optional[LlamaConfig] = None) -> ShardingRules:
     """Megatron tp + fsdp placement. Layer-stacked params carry a
     leading (unsharded) layer dim. Embedding rows over tp so the
     one-hot matmul psums over tp; lm_head columns over tp (vocab-
-    parallel logits)."""
+    parallel logits). With MoE the expert banks gain a leading E dim
+    sharded over ep (expert parallelism) while keeping the same
+    fsdp/tp layout per expert."""
     L = None  # leading layer axis of scanned params: never sharded
+    moe = bool(cfg and cfg.moe_experts)
+    ffn_up = (P(L, "ep", "fsdp", "tp") if moe else P(L, "fsdp", "tp"))
+    ffn_dn = (P(L, "ep", "tp", "fsdp") if moe else P(L, "tp", "fsdp"))
     return ShardingRules([
         (r"tok_embed$",        P("tp", "fsdp")),
         (r"layers/w[qkv]$",    P(L, "fsdp", "tp")),   # column parallel
         (r"layers/wo$",        P(L, "tp", "fsdp")),   # row parallel
-        (r"layers/w_(gate|up)$", P(L, "fsdp", "tp")),
-        (r"layers/w_down$",    P(L, "tp", "fsdp")),
+        (r"layers/moe_gate$",  P()),
+        (r"layers/w_(gate|up)$", ffn_up),
+        (r"layers/w_down$",    ffn_dn),
         (r"norm",              P()),
         (r"lm_head$",          P("fsdp", "tp")),
         (r".*",                P()),
@@ -229,18 +254,42 @@ def _layer(cfg: LlamaConfig, mesh, cos, sin, x, lp):
     x = x + constrain(o @ lp["wo"].astype(dt), *_ACT)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    delta, aux = _ffn(cfg, lp, h, mesh)
+    x = x + constrain(delta, *_ACT)
+    return x, aux
+
+
+def _ffn(cfg: LlamaConfig, lp, h, mesh, no_drop: bool = False):
+    """FFN residual delta: dense SwiGLU, or the MoE expert bank when
+    ``cfg.moe_experts`` is set (expert parallelism over 'ep';
+    ``parallel.moe``). Returns (delta, aux) — aux is the MoE
+    load-balancing term, 0 for dense. ``no_drop`` is the serving
+    setting (see moe_ffn): the cached decode path uses it so routing
+    never depends on the step's token count and decode == forward."""
+    dt = h.dtype
+    if cfg.moe_experts:
+        from ..parallel.moe import moe_ffn
+        b, s, d = h.shape
+        out, aux = moe_ffn(
+            {"gate": lp["moe_gate"], "w_gate": lp["w_gate"],
+             "w_up": lp["w_up"], "w_down": lp["w_down"]},
+            h.reshape(b * s, d), top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity, mesh=mesh,
+            no_drop=no_drop)
+        return out.reshape(b, s, d), aux
     gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
     up = h @ lp["w_up"].astype(dt)
-    x = x + constrain((gate * up) @ lp["w_down"].astype(dt), *_ACT)
-    return x
+    return (gate * up) @ lp["w_down"].astype(dt), \
+        jnp.zeros((), jnp.float32)
 
 
 def forward_hidden(cfg: LlamaConfig, params, tokens,
-                   mesh: Optional[Mesh] = None):
+                   mesh: Optional[Mesh] = None, with_aux: bool = False):
     """tokens: (batch, seq) int32 → final-norm hidden states
     (batch, seq, dim) in cfg.dtype — everything but the lm_head
     matmul, so losses can stream the vocab dim instead of
-    materializing (B, S, V) logits."""
+    materializing (B, S, V) logits. With ``with_aux`` also returns the
+    per-layer-mean MoE load-balancing aux (0 for dense configs)."""
     b, s = tokens.shape
     x = params["tok_embed"][tokens].astype(cfg.dtype)
     x = constrain(x, *_ACT)
@@ -261,14 +310,18 @@ def forward_hidden(cfg: LlamaConfig, params, tokens,
 
     if cfg.scan_layers:
         def body(x, lp):
-            return layer(x, lp), None
-        x, _ = lax.scan(body, x, params["layers"])
+            return layer(x, lp)
+        x, auxes = lax.scan(body, x, params["layers"])
+        aux = jnp.mean(auxes)
     else:
+        aux = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda a: a[i], params["layers"])
-            x = layer(x, lp)
+            x, a = layer(x, lp)
+            aux = aux + a / cfg.n_layers
 
-    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x, aux) if with_aux else x
 
 
 def _head(cfg: LlamaConfig, params):
@@ -354,7 +407,9 @@ def loss_fn(cfg: LlamaConfig, mesh: Optional[Mesh] = None):
     (see ``chunked_softmax_xent``)."""
     def loss(params, batch):
         tokens = batch["tokens"]
-        x = forward_hidden(cfg, params, tokens, mesh=mesh)[:, :-1]
+        x, moe_aux = forward_hidden(cfg, params, tokens, mesh=mesh,
+                                    with_aux=True)
+        x = x[:, :-1]
         targets = tokens[:, 1:]
         mask = batch.get("mask")
         mask = (jnp.ones_like(targets, jnp.float32) if mask is None
@@ -370,7 +425,10 @@ def loss_fn(cfg: LlamaConfig, mesh: Optional[Mesh] = None):
             logp = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None],
                                        axis=-1)[..., 0]
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        ce = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        if cfg.moe_experts:
+            ce = ce + cfg.moe_aux_weight * moe_aux
+        return ce
     return loss
 
 
@@ -513,10 +571,10 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
                   batch_ax, None, None)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    x = x + _mcon(mesh, (gate * up) @ lp["w_down"].astype(dt),
-                  batch_ax, None, None)
+    # serving: no_drop capacity — routing must not depend on how many
+    # tokens share this step (decode sees T=batch, prefill T=batch·s)
+    delta, _ = _ffn(cfg, lp, h, mesh, no_drop=True)
+    x = x + _mcon(mesh, delta, batch_ax, None, None)
     return x, ck, cv
 
 
@@ -596,20 +654,29 @@ def decode_step(cfg: LlamaConfig, params, token, cache,
 
 def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
              *, temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
              mesh: Optional[Mesh] = None):
     """Autoregressive generation: prefill + a lax.scan of decode
     steps — ONE jitted program end to end when wrapped in jax.jit
     (max_new_tokens static). temperature=0 is greedy; otherwise
-    softmax sampling at the given temperature. With ``mesh`` the whole
-    loop runs sharded (cache per :func:`cache_specs`, params as
-    placed) — serving the 8B flagship needs this: its weights alone
-    exceed one v5e chip's HBM.
+    softmax sampling at the given temperature, optionally truncated to
+    the ``top_k`` highest-probability tokens and/or the ``top_p``
+    nucleus (smallest prefix of the sorted distribution reaching p —
+    both static-shaped: masks, not dynamic vocab slices). With
+    ``mesh`` the whole loop runs sharded (cache per
+    :func:`cache_specs`, params as placed) — serving the 8B flagship
+    needs this: its weights alone exceed one v5e chip's HBM.
 
     Returns (b, prompt_len + max_new_tokens) tokens."""
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, s0 = prompt.shape
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     # init_cache(mesh=) materializes the cache directly sharded: under
@@ -624,8 +691,26 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
     def sample(rng, lg):
         if temperature == 0.0:
             return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            rng, lg / temperature, axis=-1).astype(jnp.int32)
+        lg = lg / temperature
+        if top_k is not None and top_k < lg.shape[-1]:
+            kth = lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        if top_p is not None and top_p < 1.0:
+            # nucleus: keep the smallest sorted prefix whose mass
+            # reaches p (the first token always survives)
+            order = jnp.argsort(-lg, axis=-1)
+            sorted_lg = jnp.take_along_axis(lg, order, axis=-1)
+            csum = jnp.cumsum(jax.nn.softmax(sorted_lg, axis=-1),
+                              axis=-1)
+            keep_sorted = (csum - jax.nn.softmax(sorted_lg, axis=-1)
+                           ) < top_p
+            keep = jnp.zeros_like(lg, jnp.bool_)
+            keep = keep.at[
+                jnp.arange(lg.shape[0])[:, None], order].set(
+                keep_sorted)
+            lg = jnp.where(keep, lg, -jnp.inf)
+        return jax.random.categorical(rng, lg, axis=-1) \
+            .astype(jnp.int32)
 
     rng, sub = jax.random.split(rng)
     first = sample(sub, logits[:, -1])

@@ -1,0 +1,138 @@
+"""Mixture-of-Experts with expert parallelism over the mesh ``ep``
+axis — the last SURVEY §2.4 strategy (the reference era shipped MoE
+via external frameworks; the TPU-native form is the GShard/Switch
+dispatch: token-choice top-k gating, capacity-factored einsum
+dispatch/combine, experts sharded over ``ep``, and XLA inserting the
+all-to-alls where the token-sharded and expert-sharded worlds meet).
+
+Design notes (TPU-first):
+- Everything is STATIC-SHAPED: capacity ``C`` is a Python int at trace
+  time, dropped tokens fall out via masks, and the dispatch/combine are
+  einsums — no gather/scatter with data-dependent shapes, so the whole
+  layer jits and shards like any matmul stack.
+- Expert compute is one batched einsum per projection with the expert
+  dim sharded ``P("ep")`` — each ep shard runs its E/ep experts at
+  full MXU width; the ``(E, C, d)`` dispatched activations are pinned
+  to the same layout so the dispatch einsum lowers to an all-to-all
+  over ICI rather than a replicated blow-up.
+- The SAME function runs unsharded (mesh=None) — that is the ground
+  truth the sharded path is tested against (sharding must never change
+  the math), and the single-chip serving path.
+
+Reference counterpart: none in-tree (SURVEY §2.4 lists expert
+parallelism as the one NEW-era strategy the reference lacked).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["init_moe_params", "moe_ffn", "load_balance_loss"]
+
+
+def init_moe_params(key, dim: int, hidden: int, n_experts: int,
+                    dtype=jnp.float32):
+    """Gate + SwiGLU expert bank (llama-FFN-shaped experts):
+    gate (d, E); w_gate/w_up (E, d, h); w_down (E, h, d)."""
+    kg, k1, k2, k3 = jax.random.split(key, 4)
+
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype) / math.sqrt(fan_in))
+
+    return {
+        "gate": init(kg, (dim, n_experts), dim),
+        "w_gate": init(k1, (n_experts, dim, hidden), dim),
+        "w_up": init(k2, (n_experts, dim, hidden), dim),
+        "w_down": init(k3, (n_experts, hidden, dim), hidden),
+    }
+
+
+def _con(mesh: Optional[Mesh], x, *spec):
+    if mesh is None:
+        return x
+    from .sharding import _filter_spec
+    return lax.with_sharding_constraint(
+        x, NamedSharding(mesh, _filter_spec(P(*spec), mesh.axis_names)))
+
+
+def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
+            mesh: Optional[Mesh] = None, no_drop: bool = False):
+    """Token-choice top-k MoE over SwiGLU experts.
+
+    ``x``: (T, d) tokens (flatten batch×seq first; the leading dim may
+    be dp/fsdp-sharded). Returns ``(out (T, d), aux)`` where ``aux``
+    is the Switch load-balancing loss term (add
+    ``moe_aux_weight * aux`` to the training loss; ≈1.0 at uniform
+    routing).
+
+    Tokens beyond an expert's capacity ``C = ceil(T·K/E · cf)`` are
+    dropped (their expert contribution is zero — the residual stream
+    carries them), the standard static-shape TPU trade. ``no_drop``
+    sets C = T (the worst case: every token's k-th choice on one
+    expert) — the SERVING setting, where routing must be a pure
+    function of the token, not of how many neighbors share its batch
+    (decode steps see T = batch, not batch×seq)."""
+    T, d = x.shape
+    E = params["gate"].shape[-1]
+    K = top_k
+    C = T if no_drop else max(
+        1, int(math.ceil(T * K / E * capacity_factor)))
+    dt = x.dtype
+
+    logits = (x @ params["gate"].astype(dt)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # (T, E)
+    gate_vals, idx = lax.top_k(probs, K)                    # (T, K)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # capacity-slot assignment, k-major like GShard: slot positions for
+    # the k-th choice come after every token's (k-1)-th choices
+    dispatch = jnp.zeros((T, E, C), jnp.bool_)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)
+    for k in range(K):
+        onehot = jax.nn.one_hot(idx[:, k], E, dtype=jnp.int32)  # (T, E)
+        pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None]
+        pos_t = jnp.take_along_axis(
+            pos, idx[:, k][:, None], axis=1)[:, 0]              # (T,)
+        keep = pos_t < C
+        counts = counts + onehot.sum(0)
+        slot = jax.nn.one_hot(jnp.where(keep, pos_t, C), C,
+                              dtype=jnp.float32)[:, :C]         # (T, C)
+        contrib = (onehot.astype(jnp.float32)[:, :, None] *
+                   slot[:, None, :])
+        dispatch = dispatch | (contrib > 0)
+        combine = combine + contrib * gate_vals[:, k][:, None, None]
+
+    # dispatch → expert-major activations, pinned to the ep layout so
+    # the token↔expert reshard is an all-to-all, not replication
+    xin = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x)
+    xin = _con(mesh, xin, "ep", None, None)
+    h = jax.nn.silu(jnp.einsum("ecd,edh->ech", xin,
+                               params["w_gate"].astype(dt))) * \
+        jnp.einsum("ecd,edh->ech", xin, params["w_up"].astype(dt))
+    h = _con(mesh, h, "ep", None, None)
+    eout = jnp.einsum("ech,ehd->ecd", h, params["w_down"].astype(dt))
+    eout = _con(mesh, eout, "ep", None, None)
+    out = jnp.einsum("tec,ecd->td", combine.astype(dt), eout)
+    out = _con(mesh, out, ("dp", "fsdp"), None)
+
+    aux = load_balance_loss(probs, idx[:, 0])
+    return out, aux
+
+
+def load_balance_loss(probs, first_choice):
+    """Switch-Transformer load-balancing term: E · Σ_e f_e · p̄_e,
+    where f_e is the fraction of tokens whose FIRST choice is e and
+    p̄_e the mean router probability for e. Equals 1 at uniform
+    routing; differentiable through p̄."""
+    E = probs.shape[-1]
+    f = jnp.mean(jax.nn.one_hot(first_choice, E, dtype=jnp.float32),
+                 axis=0)
+    pbar = jnp.mean(probs, axis=0)
+    return E * jnp.sum(f * pbar)

@@ -252,6 +252,139 @@ def test_sharded_embedding_lookup_matches_dense_and_grads():
                                np.asarray(g_dense), rtol=1e-5)
 
 
+def test_moe_ffn_reference_semantics():
+    """parallel.moe (expert parallelism, round 4): the capacity-based
+    einsum dispatch must equal a naive per-token gather reference when
+    nothing is dropped, drop tokens (zero contribution) when capacity
+    binds, and produce a differentiable load-balance aux."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxtpu.parallel import moe
+
+    T, d, h, E, K = 32, 16, 32, 4, 2
+    params = moe.init_moe_params(jax.random.PRNGKey(0), d, h, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, d))
+
+    out, aux = moe.moe_ffn(params, x, top_k=K, capacity_factor=8.0)
+    # naive reference: every token through its top-k experts
+    probs = jax.nn.softmax((x @ params["gate"]).astype(jnp.float32), -1)
+    gv, idx = jax.lax.top_k(probs, K)
+    gv = gv / gv.sum(-1, keepdims=True)
+    ref = np.zeros((T, d), np.float32)
+    for t in range(T):
+        for k in range(K):
+            e = int(idx[t, k])
+            xe = x[t]
+            he = jax.nn.silu(xe @ params["w_gate"][e]) * \
+                (xe @ params["w_up"][e])
+            ref[t] += float(gv[t, k]) * np.asarray(
+                he @ params["w_down"][e])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                               atol=2e-5)
+    assert 0.5 < float(aux) < 4.0          # ≈1 at uniform routing
+
+    # capacity binds: C=1 drops most tokens; dropped rows are ZERO
+    out_c, _ = moe.moe_ffn(params, x, top_k=1, capacity_factor=1e-9)
+    kept = np.abs(np.asarray(out_c)).sum(-1) > 0
+    assert kept.sum() <= E                  # ≤1 token per expert
+    # differentiable end to end (grads flow to gate and experts)
+    g = jax.grad(lambda p: moe.moe_ffn(p, x, top_k=K,
+                                       capacity_factor=8.0)[0].sum() +
+                 moe.moe_ffn(p, x, top_k=K,
+                             capacity_factor=8.0)[1])(params)
+    assert float(jnp.abs(g["gate"]).sum()) > 0
+    assert float(jnp.abs(g["w_down"]).sum()) > 0
+
+
+def test_moe_expert_parallel_matches_unsharded():
+    """Expert parallelism: the SAME moe_ffn on an ep-sharded mesh must
+    reproduce the unsharded math exactly, with the expert banks really
+    split over ep."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.parallel import moe, mesh as pmesh
+
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 (virtual) devices")
+    T, d, h, E, K = 64, 16, 32, 4, 2
+    params = moe.init_moe_params(jax.random.PRNGKey(2), d, h, E)
+    x = jax.random.normal(jax.random.PRNGKey(3), (T, d))
+    ref, ref_aux = jax.jit(
+        lambda p, xx: moe.moe_ffn(p, xx, top_k=K,
+                                  capacity_factor=2.0))(params, x)
+
+    mesh = pmesh.create_mesh(dp=2, ep=2, tp=2)
+    espec = {"gate": P(), "w_gate": P("ep", None, None),
+             "w_up": P("ep", None, None), "w_down": P("ep", None, None)}
+    sp = jax.tree.map(
+        lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+        params, espec)
+    sx = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"))))
+    out, aux = jax.jit(
+        lambda p, xx: moe.moe_ffn(p, xx, top_k=K, capacity_factor=2.0,
+                                  mesh=mesh))(sp, sx)
+    assert len(sp["w_gate"].sharding.device_set) == 8
+    assert sp["w_gate"].sharding.shard_shape(
+        sp["w_gate"].shape)[0] == E // 2     # experts really split
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-6)
+
+
+def test_moe_llama_trains_and_serves():
+    """MoE llama end to end: cfg.moe_experts swaps every FFN for the
+    expert bank; the sharded train step runs on a dp×ep×tp mesh with
+    the aux loss in, and greedy decode matches the full forward."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from dataclasses import replace
+    from mxtpu.models import llama
+    from mxtpu.parallel import mesh as pmesh, step as pstep
+
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 (virtual) devices")
+    cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                  attn_impl="dense", remat=False, moe_experts=4,
+                  moe_top_k=2, moe_capacity=4.0)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    assert params["layers"]["w_gate"].shape[1] == 4   # expert bank
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (4, 24)), jnp.int32)
+
+    mesh = pmesh.create_mesh(dp=2, ep=2, tp=2)
+    rules = llama.sharding_rules(cfg)
+    tx = optax.adam(1e-2)
+    state = pstep.init_state(params, tx, mesh, rules)
+    step = pstep.make_train_step(llama.loss_fn(cfg, mesh), tx, mesh,
+                                 rules)
+    losses = []
+    for _ in range(6):
+        state, loss = step(state, {"tokens": tokens})
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses   # it trains
+    # expert banks really ep-sharded through the step
+    wg = state.params["layers"]["w_gate"]
+    assert wg.sharding.shard_shape(wg.shape)[1] == 2  # E=4 over ep=2
+
+    # decode == forward (greedy), single device
+    p2 = llama.init_params(cfg, jax.random.PRNGKey(5))
+    prompt = tokens[:2, :6]
+    gen = jax.jit(lambda p, t: llama.generate(cfg, p, t, 4))(p2, prompt)
+    seq = np.asarray(gen)
+    for i in range(6, 10):
+        lg = llama.forward(cfg, p2, jnp.asarray(seq[:, :i]))
+        np.testing.assert_array_equal(
+            np.asarray(jnp.argmax(lg[:, -1], -1)), seq[:, i],
+            err_msg=f"pos {i}")
+
+
 def test_gpipe_matches_sequential_llama_layers():
     """VERDICT r1 #9: pp=2 GPipe schedule over llama-tiny's layer stack
     matches the 1-stage sequential numerics, forward AND backward."""
@@ -273,7 +406,8 @@ def test_gpipe_matches_sequential_llama_layers():
     cos, sin = llama.rope_tables(cfg, Ssq)
 
     def layer_fn(lp, xx):
-        return llama._layer(cfg, None, cos, sin, xx, lp)
+        # _layer returns (x, moe_aux); the dense stack only pipelines x
+        return llama._layer(cfg, None, cos, sin, xx, lp)[0]
 
     def seq_apply(layers_p, xx):
         def body(c, lp):
