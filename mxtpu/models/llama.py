@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.attention import (flash_attention, dense_attention,
                              ring_attention, ulysses_attention)
 from ..parallel.sharding import ShardingRules, constrain
+from ..parallel.sharding import mcon as _mcon
 
 __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "loss_fn", "chunked_softmax_xent", "sharding_rules",
@@ -259,23 +260,28 @@ def _layer(cfg: LlamaConfig, mesh, cos, sin, x, lp):
     return x, aux
 
 
-def _ffn(cfg: LlamaConfig, lp, h, mesh, no_drop: bool = False):
+def _ffn(cfg: LlamaConfig, lp, h, mesh, serving: bool = False):
     """FFN residual delta: dense SwiGLU, or the MoE expert bank when
     ``cfg.moe_experts`` is set (expert parallelism over 'ep';
     ``parallel.moe``). Returns (delta, aux) — aux is the MoE
-    load-balancing term, 0 for dense. ``no_drop`` is the serving
-    setting (see moe_ffn): the cached decode path uses it so routing
-    never depends on the step's token count and decode == forward."""
+    load-balancing term, 0 for dense. ``serving`` switches MoE to the
+    EXACT dropless path (moe_ffn_dense: routing is a pure per-token
+    function, linear in T) — the cached prefill/decode path uses it so
+    generation never depends on batch composition."""
     dt = h.dtype
     if cfg.moe_experts:
-        from ..parallel.moe import moe_ffn
+        from ..parallel.moe import moe_ffn, moe_ffn_dense
         b, s, d = h.shape
-        out, aux = moe_ffn(
-            {"gate": lp["moe_gate"], "w_gate": lp["w_gate"],
-             "w_up": lp["w_up"], "w_down": lp["w_down"]},
-            h.reshape(b * s, d), top_k=cfg.moe_top_k,
-            capacity_factor=cfg.moe_capacity, mesh=mesh,
-            no_drop=no_drop)
+        mp = {"gate": lp["moe_gate"], "w_gate": lp["w_gate"],
+              "w_up": lp["w_up"], "w_down": lp["w_down"]}
+        if serving:
+            out, aux = moe_ffn_dense(mp, h.reshape(b * s, d),
+                                     top_k=cfg.moe_top_k, mesh=mesh)
+        else:
+            out, aux = moe_ffn(mp, h.reshape(b * s, d),
+                               top_k=cfg.moe_top_k,
+                               capacity_factor=cfg.moe_capacity,
+                               mesh=mesh)
         return out.reshape(b, s, d), aux
     gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
     up = h @ lp["w_up"].astype(dt)
@@ -493,18 +499,8 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
     return jax.jit(build, out_shardings=shardings)()
 
 
-def _mcon(mesh: Optional[Mesh], x, *spec):
-    """Sharding constraint against an EXPLICIT mesh (decode path —
-    there is no ambient ``use_mesh`` inside a caller's jit); falls back
-    to the ambient-mesh :func:`constrain` when no mesh is passed.
-    Unknown axes are filtered, so specs name the full layout and
-    smaller meshes ignore what they lack."""
-    if mesh is None:
-        return constrain(x, *spec)
-    from jax.sharding import NamedSharding
-    from ..parallel.sharding import _filter_spec
-    return lax.with_sharding_constraint(
-        x, NamedSharding(mesh, _filter_spec(P(*spec), mesh.axis_names)))
+# (the decode path's explicit-mesh constraints use sharding.mcon,
+# imported as _mcon above)
 
 
 def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
@@ -571,9 +567,10 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
                   batch_ax, None, None)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-    # serving: no_drop capacity — routing must not depend on how many
-    # tokens share this step (decode sees T=batch, prefill T=batch·s)
-    delta, _ = _ffn(cfg, lp, h, mesh, no_drop=True)
+    # serving: exact dropless routing — generation must not depend on
+    # how many tokens share this step (decode sees T=batch, prefill
+    # T=batch·s), and capacity tensors must stay linear in T
+    delta, _ = _ffn(cfg, lp, h, mesh, serving=True)
     x = x + _mcon(mesh, delta, batch_ax, None, None)
     return x, ck, cv
 

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["init_moe_params", "moe_ffn", "load_balance_loss"]
+__all__ = ["init_moe_params", "moe_ffn", "moe_ffn_dense",
+           "load_balance_loss"]
 
 
 def init_moe_params(key, dim: int, hidden: int, n_experts: int,
@@ -54,10 +55,9 @@ def init_moe_params(key, dim: int, hidden: int, n_experts: int,
 
 def _con(mesh: Optional[Mesh], x, *spec):
     if mesh is None:
-        return x
-    from .sharding import _filter_spec
-    return lax.with_sharding_constraint(
-        x, NamedSharding(mesh, _filter_spec(P(*spec), mesh.axis_names)))
+        return x          # MoE has no ambient-mesh path to fall to
+    from .sharding import mcon
+    return mcon(mesh, x, *spec)
 
 
 def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
@@ -73,10 +73,10 @@ def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
     Tokens beyond an expert's capacity ``C = ceil(T·K/E · cf)`` are
     dropped (their expert contribution is zero — the residual stream
     carries them), the standard static-shape TPU trade. ``no_drop``
-    sets C = T (the worst case: every token's k-th choice on one
-    expert) — the SERVING setting, where routing must be a pure
-    function of the token, not of how many neighbors share its batch
-    (decode steps see T = batch, not batch×seq)."""
+    sets C = T (worst case: every token on one expert) — exact, but
+    the (T, E, C) dispatch goes QUADRATIC in T, so it is only sane for
+    tiny T; serving uses :func:`moe_ffn_dense` instead (exact routing,
+    linear in T)."""
     T, d = x.shape
     E = params["gate"].shape[-1]
     K = top_k
@@ -122,6 +122,36 @@ def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
     out = jnp.einsum("tec,ecd->td", combine.astype(dt), eout)
     out = _con(mesh, out, ("dp", "fsdp"), None)
 
+    aux = load_balance_loss(probs, idx[:, 0])
+    return out, aux
+
+
+def moe_ffn_dense(params, x, *, top_k: int = 2,
+                  mesh: Optional[Mesh] = None):
+    """EXACT dropless MoE — the serving path. Every token runs through
+    every expert; the top-k-masked renormalized gate weights combine
+    them. Routing is a pure per-token function (independent of batch
+    composition, so decode == prefill == forward), memory/compute are
+    LINEAR in T — at E/K× the routed path's FLOPs, the price of
+    exactness. Returns (out, aux) like :func:`moe_ffn`."""
+    T, d = x.shape
+    E = params["gate"].shape[-1]
+    dt = x.dtype
+    logits = (x @ params["gate"].astype(dt)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # (T, E)
+    gate_vals, idx = lax.top_k(probs, top_k)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    w = jnp.zeros((T, E), jnp.float32).at[
+        jnp.arange(T)[:, None], idx].set(gate_vals)
+
+    h = jax.nn.silu(jnp.einsum("td,edh->teh", x,
+                               params["w_gate"].astype(dt))) * \
+        jnp.einsum("td,edh->teh", x, params["w_up"].astype(dt))
+    h = _con(mesh, h, ("dp", "fsdp"), "ep", None)
+    eout = jnp.einsum("teh,ehd->ted", h, params["w_down"].astype(dt))
+    out = jnp.einsum("ted,te->td", eout, w.astype(dt))
+    out = _con(mesh, out, ("dp", "fsdp"), None)
     aux = load_balance_loss(probs, idx[:, 0])
     return out, aux
 

@@ -16,7 +16,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["P", "ShardingRules", "named", "shard_pytree", "constrain",
-           "replicated", "batch_spec", "key_str", "global_device_put"]
+           "mcon", "replicated", "batch_spec", "key_str",
+           "global_device_put"]
 
 
 def global_device_put(arr, sharding: "NamedSharding"):
@@ -133,6 +134,18 @@ def _filter_spec(spec, axis_names) -> P:
         return e if e in names else None
 
     return P(*[keep(e) for e in spec])
+
+
+def mcon(mesh: Optional[Mesh], x, *spec):
+    """Sharding constraint against an EXPLICIT mesh (the serving/MoE
+    paths, where there is no ambient ``use_mesh`` inside a caller's
+    jit); falls back to the ambient-mesh :func:`constrain` when mesh
+    is None. Unknown axes are filtered, so call sites name the full
+    canonical layout and smaller meshes ignore what they lack."""
+    if mesh is None:
+        return constrain(x, *spec)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, _filter_spec(P(*spec), mesh.axis_names)))
 
 
 def constrain(x, *spec):

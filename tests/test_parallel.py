@@ -284,6 +284,13 @@ def test_moe_ffn_reference_semantics():
                                atol=2e-5)
     assert 0.5 < float(aux) < 4.0          # ≈1 at uniform routing
 
+    # the dense dropless path (serving) == routed path when nothing
+    # drops, and == the naive reference
+    out_d, aux_d = moe.moe_ffn_dense(params, x, top_k=K)
+    np.testing.assert_allclose(np.asarray(out_d), ref, rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(float(aux_d), float(aux), rtol=1e-6)
+
     # capacity binds: C=1 drops most tokens; dropped rows are ZERO
     out_c, _ = moe.moe_ffn(params, x, top_k=1, capacity_factor=1e-9)
     kept = np.abs(np.asarray(out_c)).sum(-1) > 0

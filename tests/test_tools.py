@@ -619,7 +619,9 @@ def test_example_scripts_smoke():
                    "example/pipeline_parallel/gpipe_demo.py",
                    "example/ssd/train_ssd.py",
                    "example/rnn/bucketing/bucketing_lstm.py",
-                   "example/amp/train_amp.py"):
+                   "example/amp/train_amp.py",
+                   "example/moe/train_moe.py",
+                   "example/inference/serve_llama.py"):
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, script)],
             capture_output=True, text=True, timeout=300,
