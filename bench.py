@@ -330,6 +330,98 @@ def _aot8b_decode_impl(batch=8, prefill_len=2048):
             "vs_baseline": None}
 
 
+def bench_aot_moe():
+    """AOT lower+compile of the Mixtral-8x7B-class MoE train step AND
+    its tp8 serving decode (expert parallelism at scale): the 46.7B
+    sparse flagship on an 8-device virtual CPU mesh."""
+    return _on_cpu_mesh("_aot_moe_impl")
+
+
+def _aot_moe_impl(batch=4, seq=2048):
+    """Train: dp1×fsdp2×ep2×tp2 (expert banks over ep AND fsdp/tp per
+    expert). Serving: pure tp8, bf16 weights, dense-mixture experts.
+    Like the 8B gates, no weights materialize — eval_shape +
+    NamedShardings; the numbers are the per-device feasibility story
+    for a 46.7B sparse model."""
+    from dataclasses import replace
+    from functools import partial
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.models import llama
+    from mxtpu.parallel import mesh as pmesh, step as pstep
+
+    cfg = replace(llama.CONFIGS["mixtral_8x7b"], max_seq_len=seq)
+    mesh = pmesh.create_mesh(dp=1, fsdp=2, ep=2, tp=2)
+    rules = llama.sharding_rules(cfg)
+    tx = optax.adamw(1e-4)
+    t0 = time.perf_counter()
+    abs_params = jax.eval_shape(lambda: llama.init_params(cfg))
+    n_params = sum(x.size for x in jax.tree.leaves(abs_params))
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        abs_params, rules.tree_specs(abs_params),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    abs_opt = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        jax.eval_shape(tx.init, abs_params),
+        pstep.opt_state_shardings(tx, abs_params, mesh, rules))
+    abs_state = pstep.TrainState(
+        abs_params, abs_opt,
+        jax.ShapeDtypeStruct((), jnp.int32,
+                             sharding=NamedSharding(mesh, P())), ())
+    abs_batch = {"tokens": jax.ShapeDtypeStruct(
+        (batch, seq), jnp.int32,
+        sharding=NamedSharding(mesh, P(("dp", "fsdp"))))}
+    step = pstep.make_train_step(llama.loss_fn(cfg, mesh), tx, mesh,
+                                 rules)
+    lowered = step._jitted.lower(abs_state, abs_batch, None)
+    t_lower = time.perf_counter() - t0
+    hlo_mb = len(lowered.as_text()) / 1e6
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t1
+    mem = compiled.memory_analysis()
+    train_gb = mem.argument_size_in_bytes / 1e9
+    train_peak = mem.peak_memory_in_bytes / 1e9
+
+    # serving: bf16, pure tp8, dense-mixture experts, donated cache
+    scfg = replace(cfg, param_dtype=jnp.bfloat16)
+    smesh = pmesh.create_mesh(tp=8)
+    srules = llama.sharding_rules(scfg)
+    abs_raw = jax.eval_shape(lambda: llama.init_params(scfg))
+    abs_sp = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(smesh, s)),
+        abs_raw, srules.tree_specs(abs_raw),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    abs_cache = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(smesh, s)),
+        jax.eval_shape(lambda: llama.init_cache(scfg, 8, seq)),
+        llama.cache_specs(scfg, smesh, 8))
+    abs_tok = jax.ShapeDtypeStruct(
+        (8, 1), jnp.int32, sharding=NamedSharding(smesh, P()))
+    dstep = jax.jit(partial(llama.decode_step, scfg, mesh=smesh),
+                    donate_argnums=(2,))
+    t2 = time.perf_counter()
+    dc = dstep.lower(abs_sp, abs_tok, abs_cache).compile()
+    t_dec = time.perf_counter() - t2
+    dmem = dc.memory_analysis()
+    return {"metric": "mixtral8x7b_aot_train_state_gb_per_device",
+            "value": round(train_gb, 2), "unit": "GB",
+            "n_params_b": round(n_params / 1e9, 2),
+            "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
+            "compile_s": round(t_compile, 1),
+            "train_peak_gb": round(train_peak, 2),
+            "decode_args_gb": round(
+                dmem.argument_size_in_bytes / 1e9, 2),
+            "decode_peak_gb": round(dmem.peak_memory_in_bytes / 1e9, 2),
+            "decode_compile_s": round(t_dec, 1),
+            "train_mesh": "dp1_fsdp2_ep2_tp2",
+            "decode_mesh": "tp8_bf16", "vs_baseline": None}
+
+
 def bench_smoke_run():
     """One REAL train step on a tiny llama config — CI's bench-path
     regression check (a jit/shape break here fails bench_smoke)."""
@@ -346,10 +438,10 @@ def bench_smoke_run():
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
     if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b",
-                    "aot8b_decode"):
+                    "aot8b_decode", "aot_moe"):
         raise SystemExit(
             "usage: bench.py [all|resnet|bert|llama|smoke|aot8b|"
-            f"aot8b_decode] (got {only!r})")
+            f"aot8b_decode|aot_moe] (got {only!r})")
     if only == "smoke":
         print(json.dumps(bench_smoke_run()))
         return
@@ -358,6 +450,9 @@ def main():
         return
     if only == "aot8b_decode":
         print(json.dumps(bench_aot8b_decode()))
+        return
+    if only == "aot_moe":
+        print(json.dumps(bench_aot_moe()))
         return
     extras = []
     img_s = mfu_r = 0.0

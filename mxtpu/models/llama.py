@@ -96,6 +96,14 @@ CONFIGS: Dict[str, LlamaConfig] = {
     "llama2_7b": LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
                              n_heads=32, n_kv_heads=32, hidden_dim=11008,
                              rope_theta=10000.0, max_seq_len=4096),
+    # Mixtral-8x7B-class MoE (≈46.7B params, 12.9B active/token):
+    # 8 SwiGLU experts per layer, top-2 routing — the expert-parallel
+    # flagship config (AOT-gated in bench.py aot_moe)
+    "mixtral_8x7b": LlamaConfig(vocab_size=32000, dim=4096,
+                                n_layers=32, n_heads=32, n_kv_heads=8,
+                                hidden_dim=14336, rope_theta=1e6,
+                                max_seq_len=4096, moe_experts=8,
+                                moe_top_k=2),
 }
 
 

@@ -80,3 +80,29 @@ def test_llama3_8b_aot_decode_lower_and_compile():
     assert rec["lower_s"] < 120, rec
     assert rec["compile_s"] < 300, rec
     assert rec["prefill_compile_s"] < 300, rec
+
+
+@pytest.mark.slow
+def test_mixtral_class_moe_aot():
+    """Expert parallelism at scale (round 4): the Mixtral-8x7B-class
+    46.7B sparse flagship AOT-compiles as (a) the full sharded train
+    step on dp1×fsdp2×ep2×tp2 within a v5p's HBM, and (b) tp8 bf16
+    dense-mixture decode within a v5e's — a model 6× the dense 8B
+    serving across the same 8 chips."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    import bench
+
+    rec = bench._aot_moe_impl()
+    print(f"\nmixtral-class AOT: {rec}")
+    assert 46.0 < rec["n_params_b"] < 47.5, rec
+    # train: 46.7B f32 + AdamW mu/nu = ~560GB over 8 → ~70GB/device
+    assert 68.0 < rec["value"] < 78.0, rec
+    assert rec["train_peak_gb"] < 95, rec        # v5p HBM
+    # serving: bf16 weights 93.4GB/8 + tp-sharded cache → v5e HBM
+    assert 11.0 < rec["decode_args_gb"] < 13.0, rec
+    assert rec["decode_peak_gb"] < 16, rec       # v5e HBM
+    # scan + MoE einsums stay O(1) in depth
+    assert rec["hlo_mb"] < 5, rec
+    assert rec["compile_s"] < 600, rec
+    assert rec["decode_compile_s"] < 300, rec
