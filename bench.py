@@ -208,23 +208,27 @@ def bench_aot8b():
     return _on_cpu_mesh("_aot8b_impl")
 
 
-def _aot8b_impl():
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+# -- shared AOT scaffolding (one copy: all three gates must build the
+# abstract sharded state the same way or they'd measure different
+# things) ----------------------------------------------------------------
+def _abs_sharded_params(cfg, mesh):
+    """eval_shape'd params with rule-table NamedShardings attached."""
     from mxtpu.models import llama
-    from mxtpu.parallel import mesh as pmesh, step as pstep
-
-    cfg = llama.CONFIGS["llama3_8b"]
-    mesh = pmesh.create_mesh(dp=1, fsdp=4, tp=2)
     rules = llama.sharding_rules(cfg)
-    tx = optax.adamw(1e-4)
-    t0 = time.perf_counter()
-    abs_params = jax.eval_shape(lambda: llama.init_params(cfg))
-    abs_params = jax.tree.map(
+    from jax.sharding import NamedSharding
+    abs_p = jax.eval_shape(lambda: llama.init_params(cfg))
+    return jax.tree.map(
         lambda l, s: jax.ShapeDtypeStruct(
             l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
-        abs_params, rules.tree_specs(abs_params),
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        abs_p, rules.tree_specs(abs_p),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct)), rules
+
+
+def _abs_train_args(cfg, mesh, tx, batch_rows, seq):
+    """Abstract (TrainState, batch) for a sharded llama train step."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.parallel import step as pstep
+    abs_params, rules = _abs_sharded_params(cfg, mesh)
     abs_opt = jax.tree.map(
         lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
         jax.eval_shape(tx.init, abs_params),
@@ -234,8 +238,38 @@ def _aot8b_impl():
         jax.ShapeDtypeStruct((), jnp.int32,
                              sharding=NamedSharding(mesh, P())), ())
     abs_batch = {"tokens": jax.ShapeDtypeStruct(
-        (4, cfg.max_seq_len), jnp.int32,
+        (batch_rows, seq), jnp.int32,
         sharding=NamedSharding(mesh, P(("dp", "fsdp"))))}
+    return abs_state, abs_batch, rules
+
+
+def _abs_decode_args(cfg, mesh, batch, ctx):
+    """Abstract (params, token, cache) for a sharded decode step."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.models import llama
+    abs_params, _ = _abs_sharded_params(cfg, mesh)
+    abs_cache = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        jax.eval_shape(lambda: llama.init_cache(cfg, batch, ctx)),
+        llama.cache_specs(cfg, mesh, batch))
+    abs_tok = jax.ShapeDtypeStruct(
+        (batch, 1), jnp.int32, sharding=NamedSharding(mesh, P()))
+    return abs_params, abs_tok, abs_cache
+
+
+def _aot8b_impl():
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.models import llama
+    from mxtpu.parallel import mesh as pmesh, step as pstep
+
+    cfg = llama.CONFIGS["llama3_8b"]
+    mesh = pmesh.create_mesh(dp=1, fsdp=4, tp=2)
+    tx = optax.adamw(1e-4)
+    t0 = time.perf_counter()
+    abs_state, abs_batch, rules = _abs_train_args(
+        cfg, mesh, tx, 4, cfg.max_seq_len)
     step = pstep.make_train_step(llama.loss_fn(cfg), tx, mesh, rules)
     lowered = step._jitted.lower(abs_state, abs_batch, None)
     t_lower = time.perf_counter() - t0
@@ -248,7 +282,7 @@ def _aot8b_impl():
             "value": round(state_gb, 2), "unit": "GB",
             "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
             "compile_s": round(t_compile, 1),
-            "mesh": "dp1_fsdp4_tp2_x8", "vs_baseline": 1.0}
+            "mesh": "dp1_fsdp4_tp2_x8", "vs_baseline": None}
 
 
 def bench_aot8b_decode():
@@ -274,23 +308,10 @@ def _aot8b_decode_impl(batch=8, prefill_len=2048):
     cfg = replace(llama.CONFIGS["llama3_8b"],
                   param_dtype=jnp.bfloat16)
     mesh = pmesh.create_mesh(tp=8)
-    rules = llama.sharding_rules(cfg)
     ctx = cfg.max_seq_len
     t0 = time.perf_counter()
-    abs_params = jax.eval_shape(lambda: llama.init_params(cfg))
-    abs_params = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(
-            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
-        abs_params, rules.tree_specs(abs_params),
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-    cspecs = llama.cache_specs(cfg, mesh, batch)
-    abs_cache = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(
-            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
-        jax.eval_shape(lambda: llama.init_cache(cfg, batch, ctx)),
-        cspecs)
-    abs_tok = jax.ShapeDtypeStruct(
-        (batch, 1), jnp.int32, sharding=NamedSharding(mesh, P()))
+    abs_params, abs_tok, abs_cache = _abs_decode_args(
+        cfg, mesh, batch, ctx)
     # the cache is donated: decode must update it in place in HBM, not
     # hold two 8k-context caches during the step
     step = jax.jit(partial(llama.decode_step, cfg, mesh=mesh),
@@ -352,27 +373,11 @@ def _aot_moe_impl(batch=4, seq=2048):
 
     cfg = replace(llama.CONFIGS["mixtral_8x7b"], max_seq_len=seq)
     mesh = pmesh.create_mesh(dp=1, fsdp=2, ep=2, tp=2)
-    rules = llama.sharding_rules(cfg)
     tx = optax.adamw(1e-4)
     t0 = time.perf_counter()
-    abs_params = jax.eval_shape(lambda: llama.init_params(cfg))
-    n_params = sum(x.size for x in jax.tree.leaves(abs_params))
-    abs_params = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(
-            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
-        abs_params, rules.tree_specs(abs_params),
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-    abs_opt = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
-        jax.eval_shape(tx.init, abs_params),
-        pstep.opt_state_shardings(tx, abs_params, mesh, rules))
-    abs_state = pstep.TrainState(
-        abs_params, abs_opt,
-        jax.ShapeDtypeStruct((), jnp.int32,
-                             sharding=NamedSharding(mesh, P())), ())
-    abs_batch = {"tokens": jax.ShapeDtypeStruct(
-        (batch, seq), jnp.int32,
-        sharding=NamedSharding(mesh, P(("dp", "fsdp"))))}
+    abs_state, abs_batch, rules = _abs_train_args(cfg, mesh, tx,
+                                                  batch, seq)
+    n_params = sum(x.size for x in jax.tree.leaves(abs_state.params))
     step = pstep.make_train_step(llama.loss_fn(cfg, mesh), tx, mesh,
                                  rules)
     lowered = step._jitted.lower(abs_state, abs_batch, None)
@@ -388,20 +393,7 @@ def _aot_moe_impl(batch=4, seq=2048):
     # serving: bf16, pure tp8, dense-mixture experts, donated cache
     scfg = replace(cfg, param_dtype=jnp.bfloat16)
     smesh = pmesh.create_mesh(tp=8)
-    srules = llama.sharding_rules(scfg)
-    abs_raw = jax.eval_shape(lambda: llama.init_params(scfg))
-    abs_sp = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(
-            l.shape, l.dtype, sharding=NamedSharding(smesh, s)),
-        abs_raw, srules.tree_specs(abs_raw),
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-    abs_cache = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(
-            l.shape, l.dtype, sharding=NamedSharding(smesh, s)),
-        jax.eval_shape(lambda: llama.init_cache(scfg, 8, seq)),
-        llama.cache_specs(scfg, smesh, 8))
-    abs_tok = jax.ShapeDtypeStruct(
-        (8, 1), jnp.int32, sharding=NamedSharding(smesh, P()))
+    abs_sp, abs_tok, abs_cache = _abs_decode_args(scfg, smesh, 8, seq)
     dstep = jax.jit(partial(llama.decode_step, scfg, mesh=smesh),
                     donate_argnums=(2,))
     t2 = time.perf_counter()
