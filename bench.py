@@ -260,7 +260,6 @@ def _abs_decode_args(cfg, mesh, batch, ctx):
 
 def _aot8b_impl():
     import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
     from mxtpu.models import llama
     from mxtpu.parallel import mesh as pmesh, step as pstep
 
@@ -367,7 +366,6 @@ def _aot_moe_impl(batch=4, seq=2048):
     from dataclasses import replace
     from functools import partial
     import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
     from mxtpu.models import llama
     from mxtpu.parallel import mesh as pmesh, step as pstep
 

@@ -231,7 +231,8 @@ def _init_opt_state(optimizer, p, sharding):
                    out_shardings=(sharding,) * width)(p.data()._data)
 
 
-def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
+def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
+                    grad_accum: int = 1, loss_args: int = 0):
     """Build ``step(*batch) -> loss`` running the whole training step
     as ONE donated XLA program over ``net``'s mesh.
 
@@ -245,7 +246,24 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
     - ``step.num_compiles()`` counts compiled programs (one per
       input-shape signature) — the Trainer-step-is-ONE-program
       invariant the KVStore veneer could never give.
+    - ``grad_accum=n`` splits each batch arg's leading dim into n
+      microbatches INSIDE the program (a lax.scan): grads average, the
+      optimizer steps once, the loss returned is the microbatch mean,
+      and non-differentiable state (BatchNorm running stats) threads
+      sequentially through the microbatches — equivalent to summing n
+      per-microbatch mean losses / n in one backward. Activation
+      memory scales with the microbatch, not the batch.
+    - ``loss_args=k``: the LAST k batch args bypass the net and go to
+      ``loss_fn(out..., *extras)`` — how supervised targets ride the
+      step (they microbatch/shard with the data; a target closed over
+      in ``loss_fn`` could not).
     """
+    if grad_accum < 1:
+        raise MXNetError(f"grad_accum must be >= 1, got {grad_accum}")
+    if loss_args < 0:
+        raise MXNetError(f"loss_args must be >= 0, got {loss_args}")
+    if loss_args and loss_fn is None:
+        raise MXNetError("loss_args needs a loss_fn to receive them")
     mesh = getattr(net, "_mesh", None)
     rules = getattr(net, "_shard_rules", None)
     if mesh is None:
@@ -288,10 +306,19 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
         _TRACE_DEPTH.depth = getattr(_TRACE_DEPTH, "depth", 0) + 1
         try:
             with autograd.pause(train_mode=True):
-                out = net(*[NDArray(b) for b in batch_vals])
+                nds = [NDArray(b) for b in batch_vals]
+                if loss_args >= len(nds):
+                    raise MXNetError(
+                        f"loss_args={loss_args} but only {len(nds)} "
+                        "batch args were passed — nothing left for "
+                        "the net")
+                net_in = nds[:-loss_args] if loss_args else nds
+                extras = nds[-loss_args:] if loss_args else []
+                out = net(*net_in)
                 if loss_fn is not None:
-                    out = loss_fn(*out) if isinstance(out, tuple) \
-                        else loss_fn(out)
+                    out = loss_fn(*out, *extras) \
+                        if isinstance(out, tuple) \
+                        else loss_fn(out, *extras)
         finally:
             _TRACE_DEPTH.depth -= 1
             _random.pop_trace_key()
@@ -320,10 +347,41 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
     # hyperparameters the rebuild exists to refresh.
     def _step_body(live_vals, states, amp, frozen_vals, batch_vals,
                    hyper, key):
+        from jax import lax
         scale = (amp["scale"] if dynamic_amp
                  else jnp.ones((), jnp.float32))
-        (_, (loss, aux)), grads = grad_fn(live_vals, frozen_vals,
-                                          batch_vals, key, scale)
+        if grad_accum == 1:
+            (_, (loss, aux)), grads = grad_fn(live_vals, frozen_vals,
+                                              batch_vals, key, scale)
+        else:
+            n = grad_accum
+            mbs = [b.reshape((n, b.shape[0] // n) + b.shape[1:])
+                   for b in batch_vals]
+
+            def body(carry, xs):
+                loss_acc, grad_acc, froz = carry
+                i, mb = xs[0], list(xs[1:])
+                # distinct dropout/noise per microbatch, else
+                # accumulation isn't equivalent to the large batch
+                mb_key = jax.random.fold_in(key, i)
+                (_, (l, aux_i)), g = grad_fn(live_vals, list(froz),
+                                             mb, mb_key, scale)
+                froz = list(froz)
+                for j, v in zip(mutated_idx, aux_i):
+                    froz[j] = v          # BN stats thread sequentially
+                return (loss_acc + l,
+                        jax.tree.map(jnp.add, grad_acc, g),
+                        tuple(froz)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, live_vals)
+            (loss, grads, froz_fin), _ = lax.scan(
+                body,
+                (jnp.zeros((), jnp.float32), zeros,
+                 tuple(frozen_vals)),
+                (jnp.arange(n), *mbs))
+            loss = loss / n
+            grads = jax.tree.map(lambda g: g / n, grads)
+            aux = tuple(froz_fin[j] for j in mutated_idx)
         if dynamic_amp:
             # GLOBAL overflow decision: grads are mesh-sharded, so the
             # isfinite all-reduce below IS the cross-device/cross-host
@@ -451,9 +509,14 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None):
                     for p, s in zip(live, opt_states)]
             box["jitted"] = _make_jitted()
             box["fp"] = fp
-        batch_vals = [global_device_put(
-            b._data if isinstance(b, NDArray) else jnp.asarray(b),
-            bshard) for b in batch]
+        raw = [b._data if isinstance(b, NDArray) else jnp.asarray(b)
+               for b in batch]
+        for b in raw:
+            if b.shape[0] % grad_accum:
+                raise MXNetError(
+                    f"batch leading dim {b.shape[0]} not divisible by "
+                    f"grad_accum={grad_accum}")
+        batch_vals = [global_device_put(b, bshard) for b in raw]
         live_vals = [p.data()._data for p in live]
         frozen_vals = [p.data()._data for p in frozen]
         # schedule position + hyperparams as traced scalars: lr edits,

@@ -235,13 +235,19 @@ class Trainer:
         for i, p in live:
             updater(i, p.grad(), p.data())
 
-    def make_fused_step(self, net, loss_fn=None):
+    def make_fused_step(self, net, loss_fn=None, grad_accum=1,
+                        loss_args=0):
         """ONE-program sharded train step for a ``net.shard(mesh,
         rules)``-ed HybridBlock: forward + loss + backward + optimizer
         update compile to a single donated XLA program over the mesh
-        (see ``mxtpu.gluon.fused``)."""
+        (see ``mxtpu.gluon.fused``). ``grad_accum=n`` microbatches the
+        step inside the program (activation memory scales with the
+        microbatch); ``loss_args=k`` routes the last k batch args to
+        ``loss_fn`` instead of the net (supervised targets)."""
         from .fused import make_fused_step
-        return make_fused_step(self, net, loss_fn)
+        return make_fused_step(self, net, loss_fn,
+                               grad_accum=grad_accum,
+                               loss_args=loss_args)
 
     def zero_grad(self) -> None:
         for p in self._params:
