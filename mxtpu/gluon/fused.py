@@ -170,6 +170,19 @@ def _pure_signum(opt, t, w, g, state, lr, wd, rescale):
     return (1 - lr * opt.wd_lh) * w - lr * jnp.sign(g), state
 
 
+def _pure_sgld(opt, t, w, g, state, lr, wd, rescale, key):
+    """SGLD: gradient half-step + N(0, lr) Langevin noise. The ONLY
+    kernel that consumes the program RNG (``_needs_key``): noise comes
+    from the step's traced key folded per-parameter, so the whole
+    update stays one compiled program."""
+    g = _clipped(opt, g, rescale) + wd * w
+    noise = jax.random.normal(key, w.shape, w.dtype) * jnp.sqrt(lr)
+    return w - lr / 2 * g + noise, state
+
+
+_pure_sgld._needs_key = True
+
+
 _PURE_UPDATES: Dict[type, Callable] = {
     opt_mod.SGD: _pure_sgd,
     opt_mod.NAG: _pure_nag,
@@ -181,10 +194,8 @@ _PURE_UPDATES: Dict[type, Callable] = {
     opt_mod.RMSProp: _pure_rmsprop,
     opt_mod.Ftrl: _pure_ftrl,
     opt_mod.Signum: _pure_signum,
+    opt_mod.SGLD: _pure_sgld,
 }
-# SGLD is deliberately absent: its update injects fresh Gaussian noise
-# per step — a stateful RNG concern the fused program would need to
-# thread explicitly; the classic Trainer path serves it.
 
 
 def _pure_update_for(optimizer):
@@ -394,12 +405,16 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
         else:
             finite, t, rescale = None, hyper["t"], hyper["rescale"]
         new_live, new_states = [], []
-        for p, w, g, s in zip(live, live_vals, grads, states):
+        for pi, (p, w, g, s) in enumerate(zip(live, live_vals, grads,
+                                              states)):
             lr = hyper["lr"] * p.lr_mult
             wd = hyper["wd"] * p.wd_mult
+            kargs = ((jax.random.fold_in(key, 1_000_000 + pi),)
+                     if getattr(pure_update, "_needs_key", False)
+                     else ())
             nw, ns = pure_update(optimizer, t, w, g, s,
                                  lr.astype(w.dtype), wd.astype(w.dtype),
-                                 rescale.astype(w.dtype))
+                                 rescale.astype(w.dtype), *kargs)
             if dynamic_amp:      # overflow: drop the whole update
                 nw = jnp.where(finite, nw, w)
                 ns = jax.tree.map(lambda a, b: jnp.where(finite, a, b),
