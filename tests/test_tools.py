@@ -621,7 +621,8 @@ def test_example_scripts_smoke():
                    "example/rnn/bucketing/bucketing_lstm.py",
                    "example/amp/train_amp.py",
                    "example/moe/train_moe.py",
-                   "example/inference/serve_llama.py"):
+                   "example/inference/serve_llama.py",
+                   "example/checkpoint/resume_training.py"):
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, script)],
             capture_output=True, text=True, timeout=300,
