@@ -6,7 +6,17 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["LossScaler"]
+__all__ = ["LossScaler", "MAX_LOSS_SCALE"]
+
+#: the largest loss scale whose f32 reciprocal is still a NORMAL
+#: number (1/2**126 = 2**-126, the smallest normal). TPUs flush
+#: subnormals to zero and XLA lowers division to
+#: multiply-by-reciprocal, so unscaling by any larger scale silently
+#: zeroes every gradient while the step still counts as applied
+#: (found driving the real chip at scale 1e38; CPUs keep subnormals
+#: and hide it). 2**126 ≈ 8.5e37 is astronomically beyond any useful
+#: scale — capping costs nothing.
+MAX_LOSS_SCALE = 2.0 ** 126
 
 
 class LossScaler:
@@ -21,6 +31,23 @@ class LossScaler:
         # bfloat16 shares f32's exponent range: scale stays fixed and the
         # per-step isfinite reduction + host sync is skipped entirely
         self.dynamic = dynamic
+
+    @property
+    def loss_scale(self):
+        return self._loss_scale
+
+    @loss_scale.setter
+    def loss_scale(self, v):
+        # EVERY write is clamped to MAX_LOSS_SCALE (see above): host
+        # scalars (incl. np.float32) eagerly; device scalars (the
+        # fused step's lazy writeback, or update_scale's grow path
+        # operating on one) via a lazy jnp.minimum — no host sync, and
+        # mixed classic/fused use can never grow past the cap
+        if isinstance(v, jnp.ndarray):
+            v = jnp.minimum(v, jnp.float32(MAX_LOSS_SCALE))
+        else:
+            v = min(float(v), MAX_LOSS_SCALE)
+        self._loss_scale = v
 
     def is_finite(self, grads) -> bool:
         """Pure finiteness check — no scale update. One fused device

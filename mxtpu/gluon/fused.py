@@ -268,6 +268,21 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
       ``loss_fn(out..., *extras)`` — how supervised targets ride the
       step (they microbatch/shard with the data; a target closed over
       in ``loss_fn`` could not).
+
+    .. note:: **dynamic-AMP step counting.** Under dynamic (fp16) AMP
+       the bias-correction step count ``t`` for moment optimizers
+       (Adam/AdamW/LAMB) is the on-device APPLIED-update counter:
+       overflow-skipped steps do not advance it, matching the "a
+       skipped step never happened" semantics of torch.amp. The
+       classic ``amp.scale_loss`` + ``Trainer.step`` path counts
+       ATTEMPTS (``_index_update_count`` advances even on a skip), so
+       after the first overflow the two paths' Adam-family
+       trajectories intentionally diverge — the fused count is the
+       correct one (``test_fused_step_amp_adam_applied_count`` pins
+       this). SGD-family optimizers have no ``t`` dependence and match
+       exactly. ``amp.init_trainer`` must run BEFORE
+       ``make_fused_step``; a scaler attached afterwards raises at the
+       next ``step()`` call rather than being silently ignored.
     """
     if grad_accum < 1:
         raise MXNetError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -401,7 +416,20 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
             finite = jnp.all(jnp.stack(
                 [jnp.isfinite(g).all() for g in jax.tree.leaves(grads)]))
             t = amp["t"] + 1                     # applied-update count
-            rescale = hyper["rescale"] / scale   # unscale in the update
+            # unscale by DIVISION, like the classic path's eager
+            # unscale. Safe only because scale is capped at
+            # MAX_LOSS_SCALE = 2^126: XLA lowers division to
+            # multiply-by-reciprocal on TPU, and the reciprocal of
+            # anything larger is subnormal → flushed to zero, silently
+            # zeroing every grad while the step counts as applied
+            # (found driving the real chip at scale 1e38)
+            # divide in f32, cast back: scale is a strong f32 scalar
+            # and bare fp16/scale would promote the grads (and then
+            # the updated params) to f32
+            grads = jax.tree.map(
+                lambda g: (g.astype(jnp.float32) / scale).astype(g.dtype),
+                grads)
+            rescale = hyper["rescale"]
         else:
             finite, t, rescale = None, hyper["t"], hyper["rescale"]
         new_live, new_states = [], []
@@ -429,8 +457,11 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
             # overflow (floored), double after scale_window clean steps
             unskipped = jnp.where(finite, amp["unskipped"] + 1, 0)
             grow = unskipped >= scaler._scale_window
+            from ..amp.loss_scaler import MAX_LOSS_SCALE
             new_scale = jnp.where(
-                finite, jnp.where(grow, scale * scaler._scale_factor,
+                finite, jnp.where(grow,
+                                  jnp.minimum(scale * scaler._scale_factor,
+                                              MAX_LOSS_SCALE),
                                   scale),
                 jnp.maximum(scaler._min_scale,
                             scale / scaler._scale_factor))
@@ -500,6 +531,15 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
         """One fused train step; returns the loss NDArray."""
         from .. import autograd
         from ..parallel.sharding import global_device_put
+        if getattr(trainer, "_amp_loss_scaler", None) is not scaler:
+            # amp.init_trainer AFTER make_fused_step: the step was
+            # traced without the scaler and would silently train
+            # unscaled (r4 advisor) — fail loudly instead
+            raise MXNetError(
+                "trainer's AMP loss scaler changed after "
+                "make_fused_step (amp.init_trainer called after the "
+                "step was built?) — call make_fused_step again so AMP "
+                "is compiled into the program")
         fp = _trace_fp()
         if fp != box["fp"]:
             # a trace-frozen hyperparameter changed (momentum, betas,

@@ -683,14 +683,21 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         # mixed-precision training would break. The TPU MXU
         # accumulates bf16 convs in f32 natively, so an explicit f32
         # output buys no precision on the target hardware anyway.
+        # mixed operand dtypes (bf16 activations × f32 weights in a
+        # partially-converted AMP net): lax.conv requires matching
+        # dtypes, so promote for the conv, then cast the result back
+        # to the ACTIVATION dtype so Convolution preserves dtype
+        # propagation. Casting AFTER the conv keeps the transpose
+        # rule's operand dtypes consistent (astype transposes itself).
+        ct = jnp.promote_types(x.dtype, w.dtype)
         y = lax.conv_general_dilated(
-            x, w, window_strides=stride,
+            x.astype(ct), w.astype(ct), window_strides=stride,
             padding=[(p, p) for p in pad_],
             rhs_dilation=dilate, dimension_numbers=spec,
             feature_group_count=num_group)
         if b:
             y = y + b[0].reshape((1, -1) + (1,) * nd)
-        return y
+        return y.astype(x.dtype)
     return apply_op(_f, arrs, "Convolution")
 
 

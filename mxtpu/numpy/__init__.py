@@ -23,7 +23,7 @@ from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray, apply_op
 
 __all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
-           "linspace", "eye", "asarray", "from_nd"]
+           "linspace", "eye", "asarray", "from_nd", "take"]
 
 _np_default_dtype = _onp.float32
 
@@ -107,11 +107,17 @@ class ndarray(NDArray):
         # result independent anyway
         return self.ravel(order)
 
-    def take(self, indices, axis=None, mode="clip"):
+    def take(self, indices, axis=None, mode="raise"):
+        # NumPy's default is mode='raise'; XLA gathers cannot raise on
+        # out-of-range indices, and silently clipping would mask
+        # indexing bugs in code ported from NumPy (r4 advisor). Keep
+        # 'raise' as the default so the deviation is explicit at the
+        # call site.
         if mode not in ("clip", "wrap"):
             raise NotImplementedError(
                 f"take(mode={mode!r}): XLA gathers cannot raise on "
-                "out-of-range indices; use 'clip' (default) or 'wrap'")
+                "out-of-range indices; pass mode='clip' or mode='wrap' "
+                "explicitly")
         idx = indices._data if isinstance(indices, NDArray) else indices
         return apply_op(
             lambda x: jnp.take(x, jnp.asarray(idx), axis=axis,
@@ -329,6 +335,26 @@ def array(obj, dtype=None, ctx=None) -> ndarray:
 
 
 asarray = array
+
+
+def take(a, indices, axis=None, mode="raise", out=None):
+    """Module-level ``np.take`` with the SAME loud semantics as the
+    ndarray method: NumPy's default is mode='raise', XLA gathers
+    cannot raise, and the jnp fallthrough's 'fill' default would
+    silently return NaN — worse than clipping. Demand an explicit
+    'clip'/'wrap' at the call site instead. Parameter order follows
+    the reference ``mxnet.numpy.take(a, indices, axis, mode, out)``
+    (mode BEFORE out — NumPy itself swaps them) so MXNet-ported
+    positional calls bind correctly; the ``out=`` slot exists to fail
+    with the right message."""
+    if out is not None:
+        raise NotImplementedError(
+            "take(out=...) is not supported: XLA arrays are immutable "
+            "— use the return value")
+    if not isinstance(a, ndarray):
+        # from_nd keeps the autograd tape link; array() would sever it
+        a = from_nd(a) if isinstance(a, NDArray) else array(a)
+    return a.take(indices, axis=axis, mode=mode)
 
 
 def zeros(shape, dtype=None, ctx=None, order="C") -> ndarray:

@@ -121,6 +121,35 @@ def test_conv2d_bf16_backward_through_f32_batchnorm():
     assert str(conv_w.grad().dtype) == "bfloat16"
 
 
+def test_conv_mixed_dtype_output_follows_data():
+    """r4 advisor: bf16 activations × f32 weights must yield bf16
+    output (cast AFTER the conv — the pre-conv preferred_element_type
+    broke the transpose rule), preserving dtype propagation in
+    partially-converted AMP nets."""
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8)).astype("bfloat16")
+    w = mx.nd.array(np.random.randn(4, 3, 3, 3))        # f32
+    out = mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=4,
+                            no_bias=True)
+    assert str(out.dtype) == "bfloat16"
+    # and the backward still works across the dtype boundary
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=4,
+                              no_bias=True)
+        loss = y.astype("float32").sum()
+    loss.backward()
+    assert str(x.grad.dtype) == "bfloat16"
+    assert str(w.grad.dtype) == "float32"
+    # the output dtype follows the ACTIVATIONS even when an f32 bias
+    # would promote it — deliberate: in a partially-converted AMP net
+    # the conv must not silently widen the activation stream
+    b = mx.nd.array(np.random.randn(4))                 # f32
+    out_b = mx.nd.Convolution(x, w.astype("bfloat16"), b, kernel=(3, 3),
+                              num_filter=4)
+    assert str(out_b.dtype) == "bfloat16"
+
+
 @with_seed()
 def test_pool_layers():
     x = mx.nd.array(np.random.randn(2, 3, 8, 8))

@@ -308,9 +308,10 @@ def test_fused_step_amp_dynamic_loss_scaling():
     overflow, scaler state threaded like aux state. Trajectory must
     match the classic amp.scale_loss/Trainer.step path through a
     FORCED overflow step: Y×100 makes raw grads ≈5, so at init scale
-    1e38 the SCALED GRADS are inf (the loss scalar alone wouldn't do
+    2^126 (1e38 clamps to MAX_LOSS_SCALE — the TPU subnormal-reciprocal
+    cap) the SCALED GRADS are inf (the loss scalar alone wouldn't do
     it — backward flows through the mul symbolically) — step 1 skips
-    and halves to 5e37, steps 2-4 apply."""
+    and halves to 2^125, steps 2-4 apply."""
     from mxtpu import amp
 
     rng = np.random.default_rng(3)
@@ -358,17 +359,153 @@ def test_fused_step_amp_dynamic_loss_scaling():
     # step 1 overflowed on both paths: scale halved once, 3 of 4
     # updates applied, loss only moves once an update lands
     # fused scale is a device f32; classic is a Python float
-    assert fused.loss_scale() == pytest.approx(5e37, rel=1e-6)
-    assert tr_c._amp_loss_scaler.loss_scale == pytest.approx(5e37)
+    assert fused.loss_scale() == pytest.approx(2.0 ** 125, rel=1e-6)
+    assert tr_c._amp_loss_scaler.loss_scale == pytest.approx(2.0 ** 125)
     # the fused trainer's own scaler object stays coherent (mixed
     # classic/fused use reads the live scale)
     assert float(tr_f._amp_loss_scaler.loss_scale) == \
-        pytest.approx(5e37, rel=1e-6)
+        pytest.approx(2.0 ** 125, rel=1e-6)
     assert fused.applied_updates() == 3
     assert got[1] == pytest.approx(got[0], rel=1e-6)   # step 1 skipped
     assert got[3] < got[1]                             # then it trains
     # still ONE compiled program — the AMP machinery is in-program
     assert fused.num_compiles() == 1
+
+
+def test_fused_step_amp_adam_applied_count():
+    """r4 advisor: under dynamic AMP the fused step's bias-correction
+    count t is the on-device APPLIED-update counter — an
+    overflow-skipped step never happened, so the post-skip trajectory
+    must equal a plain (no-AMP) Adam run of only the applied steps.
+    (The classic amp path counts ATTEMPTS via _index_update_count and
+    intentionally diverges here; make_fused_step's docstring records
+    the semantics.)"""
+    from mxtpu import amp
+    from mxtpu.parallel.sharding import ShardingRules, P
+
+    rng = np.random.default_rng(11)
+    X = mx.nd.array(rng.standard_normal((32, 16)).astype(np.float32))
+    Y = mx.nd.array(
+        (100.0 * rng.standard_normal((32, 8))).astype(np.float32))
+    opt_args = {"learning_rate": 0.01}
+    amp.init("float16")
+
+    net_ref, net_f = _dense_net(), _dense_net()
+    _copy_net(net_ref, net_f)
+    for p in net_ref.collect_params().values():
+        # decouple buffers: the fused step DONATES its params, and the
+        # reference net runs after it — a shared buffer would be dead
+        p.set_data(p.data().copy())
+
+    # fused AMP: scale 1e38 (clamped to 2^126) forces an overflow on
+    # step 1; the applied steps use t = 1..applied
+    mesh = pmesh.create_mesh(dp=-1)
+    net_f.hybridize()
+    net_f.shard(mesh, ShardingRules([(r".*", P())]))
+    tr_f = gluon.Trainer(net_f.collect_params(), "adam", dict(opt_args))
+    amp.init_trainer(tr_f)
+    tr_f._amp_loss_scaler.loss_scale = 1e38
+    fused = tr_f.make_fused_step(
+        net_f, loss_fn=lambda out: ((out - Y) ** 2).mean())
+    for _ in range(4):
+        fused(X)
+    applied = fused.applied_updates()
+    assert 1 <= applied < 4          # at least one skip, one update
+
+    # reference: the SAME applied updates with no AMP at all — t
+    # advances 1..applied. Skipped steps change nothing (params frozen,
+    # X/Y fixed), so the applied updates ARE a plain Adam trajectory of
+    # that length. If the fused path used the attempt counter instead,
+    # the bias-corrected lr differs ~40% on the first post-skip step
+    # and this comparison fails.
+    tr_r = gluon.Trainer(net_ref.collect_params(), "adam",
+                         dict(opt_args))
+    for _ in range(applied):
+        with autograd.record():
+            loss = ((net_ref(X) - Y) ** 2).mean()
+        loss.backward()
+        tr_r.step(1)
+
+    for pr, pf in zip(net_ref.collect_params().values(),
+                      net_f.collect_params().values()):
+        np.testing.assert_allclose(
+            pr.data().asnumpy(), pf.data().asnumpy(),
+            rtol=2e-4, atol=1e-6, err_msg=pr.name)
+
+
+def test_loss_scaler_max_scale_clamp():
+    """Every loss_scale write clamps to MAX_LOSS_SCALE = 2^126 — the
+    largest scale whose f32 reciprocal is a NORMAL number. TPUs flush
+    subnormals to zero, so a larger scale silently zeroes every
+    unscaled gradient (found driving the real chip). Host floats,
+    np scalars, and device scalars (the grow path under mixed
+    classic/fused use) must all be capped."""
+    from mxtpu.amp.loss_scaler import LossScaler, MAX_LOSS_SCALE
+
+    s = LossScaler()
+    s.loss_scale = 1e38
+    assert s.loss_scale == MAX_LOSS_SCALE
+    s.loss_scale = np.float32(1e38)                # not a float subclass
+    assert float(s.loss_scale) == MAX_LOSS_SCALE
+    s.loss_scale = jnp.float32(MAX_LOSS_SCALE)     # device scalar
+    s._unskipped = s._scale_window - 1
+    s.update_scale(False)                          # grow on-device
+    assert float(s.loss_scale) == MAX_LOSS_SCALE
+
+
+def test_fused_step_amp_fp16_params_keep_dtype():
+    """The in-program unscale divides by an f32 scale; fp16-cast
+    params must come back fp16 (not silently promoted to f32, which
+    would also force a step-2 recompile)."""
+    from mxtpu import amp
+    from mxtpu.parallel.sharding import ShardingRules, P
+
+    rng = np.random.default_rng(13)
+    X = mx.nd.array(rng.standard_normal((16, 16)).astype(np.float16))
+    Y = mx.nd.array(rng.standard_normal((16, 8)).astype(np.float16))
+    net = _dense_net()
+    for p in net.collect_params().values():
+        p.cast("float16")
+    amp.init("float16")
+    mesh = pmesh.create_mesh(dp=-1)
+    net.hybridize()
+    net.shard(mesh, ShardingRules([(r".*", P())]))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01})
+    amp.init_trainer(tr)
+    fused = tr.make_fused_step(
+        net, loss_fn=lambda out: ((out - Y) ** 2).mean())
+    for _ in range(2):
+        fused(X)
+    for p in net.collect_params().values():
+        assert str(p.data().dtype) == "float16", p.name
+    assert fused.num_compiles() == 1
+
+
+def test_fused_step_late_amp_init_raises():
+    """r4 advisor: amp.init_trainer AFTER make_fused_step used to be
+    silently ignored (the step was traced scaler-less). It must fail
+    loudly at the next step() call."""
+    from mxtpu import amp
+    from mxtpu.base import MXNetError
+    from mxtpu.parallel.sharding import ShardingRules, P
+
+    rng = np.random.default_rng(12)
+    X = mx.nd.array(rng.standard_normal((8, 16)).astype(np.float32))
+    Y = mx.nd.array(rng.standard_normal((8, 8)).astype(np.float32))
+    net = _dense_net()
+    mesh = pmesh.create_mesh(dp=-1)
+    net.hybridize()
+    net.shard(mesh, ShardingRules([(r".*", P())]))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    fused = tr.make_fused_step(
+        net, loss_fn=lambda out: ((out - Y) ** 2).mean())
+    fused(X)                                   # scaler-less: fine
+    amp.init("float16")
+    amp.init_trainer(tr)                       # too late
+    with pytest.raises(MXNetError, match="make_fused_step again"):
+        fused(X)
 
 
 def test_fused_step_hyperparam_fingerprint_retrace():

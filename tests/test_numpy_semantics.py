@@ -144,8 +144,38 @@ def test_indexing_family():
     a = mnp.array(x, dtype="float64")
     check(a[1:4, ::2], x[1:4, ::2])
     check(a[::-1], x[::-1])
-    check(mnp.take(a, mnp.array([0, 4], dtype="int32"), axis=0),
-          onp.take(x, [0, 4], axis=0))
+    check(mnp.take(a, mnp.array([0, 4], dtype="int32"), axis=0,
+                   mode="clip"),
+          onp.take(x, [0, 4], axis=0, mode="clip"))
+    # take keeps NumPy's mode='raise' DEFAULT but cannot implement it
+    # (XLA gathers never raise): the deviation must be explicit at the
+    # call site (r4 advisor) — on the method AND the module function
+    # (whose jnp fallthrough would otherwise silently NaN-fill)
+    with pytest.raises(NotImplementedError, match="mode='clip'"):
+        a.take(mnp.array([0], dtype="int32"), axis=0)
+    with pytest.raises(NotImplementedError, match="mode='clip'"):
+        mnp.take(a, mnp.array([0], dtype="int32"), axis=0)
+    check(a.take(mnp.array([0, 99], dtype="int32"), axis=0,
+                 mode="clip"),
+          onp.take(x, [0, 99], axis=0, mode="clip"))
+    # reference-order positional calls (a, indices, axis, mode, out):
+    # mode binds as the 4th positional; out= is unsupported but must
+    # say SO (not misbind)
+    check(mnp.take(a, mnp.array([0, 4], dtype="int32"), 0, "clip"),
+          onp.take(x, [0, 4], axis=0, mode="clip"))
+    with pytest.raises(NotImplementedError, match="out"):
+        mnp.take(a, mnp.array([0], dtype="int32"), 0, "clip",
+                 onp.zeros(1))
+    # module-level take on an mx.nd input keeps the autograd tape
+    from mxtpu import autograd as ag
+    xs = mx.nd.array(onp.arange(4.0, dtype=onp.float32))
+    xs.attach_grad()
+    with ag.record():
+        y = mnp.take(xs, mnp.array([1, 2], dtype="int32"), axis=0,
+                     mode="clip")
+        s = y.as_nd_ndarray().sum()
+    s.backward()
+    onp.testing.assert_allclose(xs.grad.asnumpy(), [0, 1, 1, 0])
     idx = onp.array([[0, 1], [2, 3]])
     check(mnp.take_along_axis(
         a, mnp.array(idx, dtype="int64"), axis=0)
