@@ -20,6 +20,7 @@ ride in "extra" with their own vs_baseline:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -412,6 +413,33 @@ def _aot_moe_impl(batch=4, seq=2048):
             "decode_mesh": "tp8_bf16", "vs_baseline": None}
 
 
+def bench_input_pipeline():
+    """Native input-pipeline decode throughput (VERDICT r4 #1): runs
+    benchmark/input_bench.py in a subprocess (it imports the TF-backed
+    python path for contrast; isolate that from this process) and
+    returns its record. Host-side only — measures whether this host
+    can FEED the chip."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "benchmark", "input_bench.py"),
+             "--n", "300", "--seconds", "1.5"],
+            capture_output=True, text=True, timeout=600)
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("{")][-1]
+        rec = json.loads(line)
+        if "metric" not in rec:      # e.g. {"error": "libmxtpu ..."}
+            raise RuntimeError(rec.get("error", "malformed record"))
+    except Exception as e:                      # never sink the bench
+        return {"metric": "input_pipeline_native_img_s", "value": 0.0,
+                "unit": "img/s", "vs_baseline": None,
+                "error": str(e)[:200]}
+    rec.setdefault("vs_baseline", None)
+    return rec
+
+
 def bench_smoke_run():
     """One REAL train step on a tiny llama config — CI's bench-path
     regression check (a jit/shape break here fails bench_smoke)."""
@@ -428,10 +456,10 @@ def bench_smoke_run():
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
     if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b",
-                    "aot8b_decode", "aot_moe"):
+                    "aot8b_decode", "aot_moe", "input"):
         raise SystemExit(
             "usage: bench.py [all|resnet|bert|llama|smoke|aot8b|"
-            f"aot8b_decode|aot_moe] (got {only!r})")
+            f"aot8b_decode|aot_moe|input] (got {only!r})")
     if only == "smoke":
         print(json.dumps(bench_smoke_run()))
         return
@@ -455,6 +483,9 @@ def main():
                        "mfu": round(mfu_b, 3),
                        "vs_baseline": round(s_s / BASELINE_BERT_SAMPLES_S,
                                             3)})
+    if only == "input":
+        print(json.dumps(bench_input_pipeline()))
+        return
     if only in ("all", "llama"):
         t_s, mfu_l, n_p = bench_llama()
         extras.append({"metric": "llama_500m_train_tokens_per_s",
@@ -465,6 +496,8 @@ def main():
         extras.append({"metric": "llama_500m_decode_tokens_per_s",
                        "value": round(d_s, 1), "unit": "tok/s",
                        "vs_baseline": None})
+    if only == "all":
+        extras.append(bench_input_pipeline())
     out = {
         "metric": "resnet50_train_throughput_per_chip",
         "value": round(img_s, 1),

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Real-data training through the NATIVE input pipeline (reference
+``example/image-classification/train_imagenet.py`` +
+``src/io/iter_image_recordio_2.cc`` [path cites — unverified]): JPEG
+.rec → C++ threaded decode → device-side normalize → fused one-program
+train step on a model-zoo ResNet.
+
+The input pipeline is the measured subject here (VERDICT r4 #1): the
+script reports BOTH the pure input rate and the end-to-end training
+rate so the input-bound/compute-bound verdict is visible per run.
+
+Smoke: MXTPU_SMOKE=1 shrinks everything (64px, resnet18, 128 images)
+so the example runs in under a minute on the CPU mesh.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+SMOKE = bool(int(os.environ.get("MXTPU_SMOKE", "0")))
+
+
+def synth_jpeg_rec(path, n, size, classes):
+    """Photographic-ish JPEGs (gradients + noise + a class-dependent
+    tint so the task is learnable)."""
+    from mxtpu import recordio
+    rng = np.random.default_rng(0)
+    w = recordio.MXIndexedRecordIO(
+        os.path.splitext(path)[0] + ".idx", path, "w")
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    for i in range(n):
+        cls = i % classes
+        base = 110 + 70 * np.sin(6.28 * (xx * (1 + i % 4) + yy))
+        img = np.stack([base] * 3, axis=-1)
+        img[:, :, cls % 3] += 60.0          # learnable color cue
+        img += rng.normal(0, 10, img.shape)
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(cls), i, 0), img, quality=90))
+    w.close()
+    return path
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rec", default=None, help=".rec path (generated "
+                   "synthetically when omitted)")
+    p.add_argument("--model", default="resnet18_v1" if SMOKE
+                   else "resnet50_v1")
+    p.add_argument("--size", type=int, default=64 if SMOKE else 224)
+    p.add_argument("--images", type=int, default=128 if SMOKE else 1024)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32 if SMOKE else 64)
+    p.add_argument("--epochs", type=int, default=12 if SMOKE else 4)
+    p.add_argument("--threads", type=int, default=2)
+    args = p.parse_args()
+
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu import io as mio
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import mesh as pmesh
+    from mxtpu.parallel.sharding import ShardingRules, P
+
+    rec = args.rec
+    if rec is None:
+        rec = os.path.join(tempfile.mkdtemp(), "train.rec")
+        synth_jpeg_rec(rec, args.images, args.size + args.size // 8,
+                       args.classes)
+
+    it = mio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, args.size, args.size),
+        batch_size=args.batch_size, shuffle=True,
+        preprocess_threads=args.threads,
+        mean_r=123.7, mean_g=116.3, mean_b=103.5,
+        std_r=58.4, std_g=57.1, std_b=57.4)
+    native = type(it).__name__ == "NativeImageRecordIter"
+
+    # pure input rate first (decode+normalize+upload, no training);
+    # fence the last batch — .next() dispatches the device-side
+    # normalize asynchronously and the clock must not stop early
+    t0 = time.perf_counter()
+    n_in, last = 0, None
+    for b in it:
+        n_in += b.data[0].shape[0] - b.pad
+        last = b
+    if last is not None:
+        last.data[0].asnumpy()
+    input_rate = n_in / (time.perf_counter() - t0)
+    it.reset()
+
+    net = vision.get_model(args.model, classes=args.classes)
+    net.initialize()
+    net.hybridize()
+    net(it.next().data[0])         # resolve deferred shapes
+    it.reset()
+    mesh = pmesh.create_mesh(dp=-1)
+    net.shard(mesh, ShardingRules([(r".*", P())]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9,
+                             "wd": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = trainer.make_fused_step(
+        net, loss_fn=lambda out, y: loss_fn(out, y).mean(), loss_args=1)
+
+    seen, last_loss = 0, None
+    t0 = time.perf_counter()
+    for epoch in range(args.epochs):
+        it.reset()
+        for batch in it:
+            x, y = batch.data[0], batch.label[0]
+            # pad rows would dilute the loss; the generated .rec is
+            # batch-divisible so drop ragged tails instead
+            if batch.pad:
+                continue
+            last_loss = step(x, y)         # async — decode overlaps TPU
+            seen += x.shape[0]
+        if last_loss is None:
+            raise SystemExit("no full batches: --images must be >= "
+                             "--batch-size (pad-only batches are "
+                             "dropped)")
+        if epoch == 0:
+            # exclude the first epoch (XLA compile) from the rate
+            float(last_loss.asscalar())
+            seen, t0 = 0, time.perf_counter()
+    final_loss = float(last_loss.asscalar())   # fence
+    train_rate = seen / (time.perf_counter() - t0)
+
+    # accuracy drive-by (real-data smoke must LEARN, not just run)
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        n_valid = batch.data[0].shape[0] - batch.pad
+        pred = net(batch.data[0]).asnumpy()[:n_valid].argmax(axis=1)
+        correct += int((pred == batch.label[0].asnumpy()[:n_valid]).sum())
+        total += n_valid
+    it.close()
+
+    acc = correct / max(total, 1)
+    print(json.dumps({
+        "native_pipeline": native,
+        "input_img_s": round(input_rate, 1),
+        "train_img_s": round(train_rate, 1),
+        "final_loss": round(final_loss, 4),
+        "accuracy": round(acc, 4),
+        "model": args.model, "size": args.size,
+        "input_bound": bool(input_rate < train_rate * 1.5)}))
+    assert acc > 0.8, f"did not learn: acc={acc}"
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

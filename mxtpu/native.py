@@ -74,7 +74,12 @@ def get_lib():
         lib.mxtpu_pipe_create.restype = ctypes.c_void_p
         lib.mxtpu_pipe_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_uint, ctypes.c_int]
+            ctypes.c_int, ctypes.c_uint, ctypes.c_int, ctypes.c_int]
+        lib.mxtpu_pipe_next_u8.restype = ctypes.c_long
+        lib.mxtpu_pipe_next_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.POINTER(ctypes.c_float)]
         lib.mxtpu_pipe_next.restype = ctypes.c_long
         lib.mxtpu_pipe_next.argtypes = [
             ctypes.c_void_p, ctypes.c_long,
@@ -152,28 +157,40 @@ class NativePipeline:
 
     def __init__(self, rec_path: str, height: int, width: int,
                  channels: int = 3, shuffle: bool = False, seed: int = 0,
-                 threads: int = 2):
+                 threads: int = 2, out_u8: bool = False):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("libmxtpu unavailable")
         self._lib = lib
         self._hwc = (height, width, channels)
+        self._u8 = bool(out_u8)
         self._h = lib.mxtpu_pipe_create(rec_path.encode(), height, width,
                                         channels, int(shuffle), seed,
-                                        threads)
+                                        threads, int(out_u8))
         if not self._h:
             raise IOError(f"cannot open {rec_path}")
 
     def next_batch(self, batch_size: int):
-        """Returns (data (n,h,w,c) float32, labels (n,)) with n ≤
-        batch_size; n==0 means the epoch is exhausted."""
+        """Returns (data (n,h,w,c), labels (n,)) with n ≤ batch_size;
+        n==0 means the epoch is exhausted. Data is float32, or uint8
+        when built with ``out_u8`` (quarter the host→device bytes —
+        convert/normalize on the accelerator)."""
         h, w, c = self._hwc
-        data = onp.empty((batch_size, h, w, c), onp.float32)
         labels = onp.empty((batch_size,), onp.float32)
-        n = self._lib.mxtpu_pipe_next(
-            self._h, batch_size,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        lp = labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        if self._u8:
+            data = onp.empty((batch_size, h, w, c), onp.uint8)
+            n = self._lib.mxtpu_pipe_next_u8(
+                self._h, batch_size,
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), lp)
+        else:
+            data = onp.empty((batch_size, h, w, c), onp.float32)
+            n = self._lib.mxtpu_pipe_next(
+                self._h, batch_size,
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), lp)
+        if n < 0:
+            raise RuntimeError("pipe output-mode mismatch (out_u8 flag "
+                               "does not match the create() mode)")
         return data[:n], labels[:n]
 
     def reset(self):

@@ -157,7 +157,9 @@ void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, float* dst,
 // threaded decode pipeline
 // ---------------------------------------------------------------------------
 struct Sample {
-  std::vector<float> data;   // h*w*c
+  std::vector<float> data;   // h*w*c (f32 mode)
+  std::vector<uint8_t> u8;   // h*w*c (u8 mode: round(resize) — the
+                             // device does convert/normalize/layout)
   float label = 0.f;
   bool valid = false;        // skip markers keep the sequence contiguous
 };
@@ -165,6 +167,9 @@ struct Sample {
 struct Pipeline {
   RecFile rec;
   int h, w, c;
+  bool out_u8 = false;   // emit rounded uint8 samples (quarter the
+                         // host→device bytes; decode+resize is the
+                         // host's job, normalize/layout the device's)
   bool shuffle;
   uint32_t seed, epoch = 0;
   std::vector<uint32_t> order;
@@ -233,6 +238,15 @@ struct Pipeline {
               ResizeBilinear(crop.data(), ch, cw, dc, s.data.data(),
                              h, w);
             }
+            if (out_u8) {
+              // round in the WORKER (parallel); ≤0.5 LSB vs the f32
+              // path, well inside decoder-parity tolerances
+              s.u8.resize(s.data.size());
+              for (size_t i = 0; i < s.data.size(); ++i)
+                s.u8[i] = (uint8_t)(s.data[i] + 0.5f);
+              s.data.clear();
+              s.data.shrink_to_fit();
+            }
           }
         }
       }
@@ -277,6 +291,36 @@ struct Pipeline {
     ready.clear();
   }
 };
+
+// drain up to `batch` ordered samples through `sink(sample, slot)`
+template <typename Sink>
+long PipeDrain(Pipeline* p, long batch, float* labels, Sink sink) {
+  long filled = 0;
+  while (filled < batch) {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_pop.wait(lk, [&] {
+      return p->ready.count((uint32_t)p->next_emit) ||
+             p->active_workers == 0;
+    });
+    auto it = p->ready.find((uint32_t)p->next_emit);
+    if (it == p->ready.end()) {
+      // workers finished; skip over any hole a dying worker left
+      if (p->ready.empty()) break;
+      it = p->ready.begin();
+      p->next_emit = it->first;
+    }
+    Sample s = std::move(it->second);
+    p->ready.erase(it);
+    ++p->next_emit;
+    lk.unlock();
+    p->cv_push.notify_all();
+    if (!s.valid) continue;                  // skipped record
+    sink(s, filled);
+    labels[filled] = s.label;
+    ++filled;
+  }
+  return filled;
+}
 
 }  // namespace
 
@@ -341,7 +385,8 @@ void mxtpu_resize_bilinear(const uint8_t* src, int sh, int sw, int c,
 
 // -- pipeline ---------------------------------------------------------------
 void* mxtpu_pipe_create(const char* rec_path, int h, int w, int c,
-                        int shuffle, unsigned seed, int nthreads) {
+                        int shuffle, unsigned seed, int nthreads,
+                        int out_u8) {
   void* rh = mxtpu_rec_open(rec_path);
   if (!rh) return nullptr;
   Pipeline* p = new Pipeline();
@@ -351,6 +396,7 @@ void* mxtpu_pipe_create(const char* rec_path, int h, int w, int c,
   p->h = h;
   p->w = w;
   p->c = c;
+  p->out_u8 = out_u8 != 0;
   p->shuffle = shuffle != 0;
   p->seed = seed;
   p->nthreads = nthreads > 0 ? nthreads : 1;
@@ -358,36 +404,27 @@ void* mxtpu_pipe_create(const char* rec_path, int h, int w, int c,
   return p;
 }
 
-// fill up to batch samples; returns count (0 = epoch exhausted)
+// fill up to batch samples; returns count (0 = epoch exhausted),
+// -1 on mode mismatch (pipe was created with out_u8=1)
 long mxtpu_pipe_next(void* h, long batch, float* data, float* labels) {
   Pipeline* p = static_cast<Pipeline*>(h);
-  long filled = 0;
+  if (p->out_u8) return -1;   // samples hold u8; f32 read would be UB
   size_t sample_sz = (size_t)p->h * p->w * p->c;
-  while (filled < batch) {
-    std::unique_lock<std::mutex> lk(p->mu);
-    p->cv_pop.wait(lk, [&] {
-      return p->ready.count((uint32_t)p->next_emit) ||
-             p->active_workers == 0;
-    });
-    auto it = p->ready.find((uint32_t)p->next_emit);
-    if (it == p->ready.end()) {
-      // workers finished; skip over any hole a dying worker left
-      if (p->ready.empty()) break;
-      it = p->ready.begin();
-      p->next_emit = it->first;
-    }
-    Sample s = std::move(it->second);
-    p->ready.erase(it);
-    ++p->next_emit;
-    lk.unlock();
-    p->cv_push.notify_all();
-    if (!s.valid) continue;                  // skipped record
-    memcpy(data + filled * sample_sz, s.data.data(),
+  return PipeDrain(p, batch, labels, [&](const Sample& s, long i) {
+    memcpy(data + i * sample_sz, s.data.data(),
            sample_sz * sizeof(float));
-    labels[filled] = s.label;
-    ++filled;
-  }
-  return filled;
+  });
+}
+
+// u8 variant; -1 unless the pipe was created with out_u8=1
+long mxtpu_pipe_next_u8(void* h, long batch, uint8_t* data,
+                        float* labels) {
+  Pipeline* p = static_cast<Pipeline*>(h);
+  if (!p->out_u8) return -1;
+  size_t sample_sz = (size_t)p->h * p->w * p->c;
+  return PipeDrain(p, batch, labels, [&](const Sample& s, long i) {
+    memcpy(data + i * sample_sz, s.u8.data(), sample_sz);
+  });
 }
 
 void mxtpu_pipe_reset(void* h) {

@@ -135,6 +135,31 @@ def test_native_center_crop_matches_python(rec_file):
     assert onp.abs(native - ref).mean() < 6.0
 
 
+def test_native_u8_device_pipeline_matches_f32_host_path(rec_file):
+    """The r5 fast path (uint8 handover + on-device convert/normalize/
+    transpose) must reproduce the all-host f32 path to within the 0.5
+    LSB the worker-side rounding costs."""
+    path, _ = rec_file
+    kw = dict(path_imgrec=path, data_shape=(3, 16, 16), batch_size=4,
+              preprocess_threads=1, mean=[10.0, 20.0, 30.0],
+              std=[2.0, 3.0, 4.0])
+    it_dev = mio.NativeImageRecordIter(device_pipeline=True, **kw)
+    it_host = mio.NativeImageRecordIter(device_pipeline=False, **kw)
+    n = 0
+    for bd, bh in zip(it_dev, it_host):
+        d, h = bd.data[0].asnumpy(), bh.data[0].asnumpy()
+        assert d.shape == h.shape == (4, 3, 16, 16)
+        assert d.dtype == onp.float32
+        # 0.5 raw-pixel rounding / smallest std 2.0 = 0.25
+        assert onp.abs(d - h).max() <= 0.26, onp.abs(d - h).max()
+        onp.testing.assert_allclose(bd.label[0].asnumpy(),
+                                    bh.label[0].asnumpy())
+        n += 1
+    assert n == 3                    # 10 imgs / batch 4, incl. pad
+    it_dev.close()
+    it_host.close()
+
+
 def test_imagerecorditer_routes_python_for_unsupported_kwargs(rec_file):
     path, _ = rec_file
     it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
