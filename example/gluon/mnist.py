@@ -54,7 +54,8 @@ def main():
     p.add_argument("--data-dir", default=None)
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
-    ctx = mx.cpu() if args.cpu else mx.tpu()
+    ctx = mx.cpu() if args.cpu or not mx.context.num_tpus() \
+        else mx.tpu()
 
     train_iter, _ = load_data(args.data_dir, args.batch_size)
     net = build_net()

@@ -13,12 +13,13 @@ import numpy as np
 
 
 def score(mx, model, batch, size, iters=20):
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
     from mxtpu.gluon.model_zoo import vision
     net = vision.get_model(model)
-    net.initialize(ctx=mx.tpu())
+    net.initialize(ctx=ctx)
     net.hybridize()
     x = mx.nd.array(np.random.default_rng(0).standard_normal(
-        (batch, 3, size, size)).astype(np.float32), ctx=mx.tpu())
+        (batch, 3, size, size)).astype(np.float32), ctx=ctx)
     net(x).wait_to_read()          # compile
     net(x).wait_to_read()
     t0 = time.perf_counter()

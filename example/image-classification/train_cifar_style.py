@@ -62,8 +62,9 @@ def main():
                              batch_size=args.batch_size, shuffle=True,
                              mean_r=128, mean_g=128, mean_b=128,
                              std_r=64, std_g=64, std_b=64)
-    mod = mx.mod.Module(build_symbol(mx, args.classes),
-                        context=mx.cpu() if args.cpu else mx.tpu())
+    ctx = mx.cpu() if args.cpu or not mx.context.num_tpus() \
+        else mx.tpu()
+    mod = mx.mod.Module(build_symbol(mx, args.classes), context=ctx)
     mod.fit(it, optimizer="sgd",
             optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
             eval_metric="acc", num_epoch=args.epochs,

@@ -91,7 +91,9 @@ def main():
         n_in += b.data[0].shape[0] - b.pad
         last = b
     if last is not None:
-        last.data[0].asnumpy()
+        # scalar fence: a readback DEPENDENT on the batch, without
+        # timing a 38 MB D2H no training loop does
+        float(last.data[0][0, 0, 0, 0].asscalar())
     input_rate = n_in / (time.perf_counter() - t0)
     it.reset()
 

@@ -21,7 +21,8 @@ def main():
     p.add_argument("--epochs", type=int, default=30)
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
-    ctx = mx.cpu() if args.cpu else mx.tpu()
+    ctx = mx.cpu() if args.cpu or not mx.context.num_tpus() \
+        else mx.tpu()
 
     text = ("the quick brown fox jumps over the lazy dog. " * 50)
     vocab = sorted(set(text))

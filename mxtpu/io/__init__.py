@@ -359,6 +359,7 @@ class PrefetchingIter(DataIter):
         """Stop the prefetch thread deterministically (join, not
         daemon-kill at exit) and close the inner iterator."""
         self._halt()
+        self._done = True      # next() must raise, not block forever
         if hasattr(self._it, "close"):
             self._it.close()
 
@@ -407,6 +408,7 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
     # anything else (label_width, hue, inter_method, augmenters, ...)
     # goes through the Python ImageIter
     native_ok_keys = {"seed", "data_name", "label_name"}
+    device_pipeline = kwargs.pop("device_pipeline", True)
     blocking = {k for k, v in kwargs.items()
                 if k not in native_ok_keys and v}
     if not blocking and data_shape and data_shape[0] == 3:
@@ -416,7 +418,8 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
                 path_imgrec=path_imgrec, data_shape=data_shape,
                 batch_size=batch_size, shuffle=shuffle,
                 preprocess_threads=preprocess_threads, mean=mean, std=std,
-                seed=int(kwargs.get("seed", 0)))
+                seed=int(kwargs.get("seed", 0)),
+                device_pipeline=device_pipeline)
     from ..image import ImageIter
     inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
                       shuffle=shuffle, mean=mean, std=std, **kwargs)

@@ -196,7 +196,14 @@ def argmin(data, axis=None, keepdims=False, **kwargs):
 
 
 @register_op("topk")
-def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, **kwargs):
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32", **kwargs):
+    # dtype governs the INDEX dtype (reference topk's dtype param);
+    # the float32 default is reference parity, but it rounds indices
+    # past 2^24 — pass dtype="int32"/"int64" for exact large-axis
+    # indices (tests/test_boundaries.py)
+    idt = dtype_np(dtype)
+
     def _f(x):
         xm = jnp.moveaxis(x, axis, -1)
         vals, idx = lax.top_k(-xm if is_ascend else xm, k)
@@ -207,8 +214,8 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, **kwargs):
         if ret_typ == "value":
             return vals
         if ret_typ == "both":
-            return (vals, idx.astype(jnp.float32))
-        return idx.astype(jnp.float32)
+            return (vals, idx.astype(idt))
+        return idx.astype(idt)
     n_out = 2 if ret_typ == "both" else 1
     return apply_op(_f, [data], "topk", n_out=n_out)
 
@@ -1024,9 +1031,14 @@ def add_n(*args, **kwargs):
 
 @register_op("cumsum")
 def cumsum(a, axis=None, dtype=None, **kwargs):
+    # dtype is the ACCUMULATOR dtype and must reach jnp.cumsum —
+    # casting after the scan would first overflow/round in the input
+    # dtype (int32 totals past 2^31 wrapped to 0;
+    # tests/test_boundaries.py)
     def _f(x):
-        out = jnp.cumsum(x.reshape(-1) if axis is None else x, axis=axis or 0)
-        return out.astype(dtype_np(dtype)) if dtype else out
+        return jnp.cumsum(x.reshape(-1) if axis is None else x,
+                          axis=axis or 0,
+                          dtype=dtype_np(dtype) if dtype else None)
     return apply_op(_f, [a], "cumsum")
 
 

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Regenerate the committed backward-compat artifacts (the analogue of
+the reference's ``tests/nightly/model_backwards_compatibility_check``:
+artifacts SAVED by an earlier version must keep LOADING in every later
+one). Run from the repo root, commit the outputs, and bump VERSION
+when the on-disk formats intentionally change:
+
+    python tests/artifacts/make_artifacts.py
+
+The contents are fully deterministic (arange-derived) so
+``test_backward_compat.py`` asserts exact values, not just load
+success.
+"""
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", ".."))
+sys.path.insert(0, REPO)
+HERE = os.path.join(REPO, "tests", "artifacts", "r5")
+
+VERSION = "r5"
+
+
+def dense_net(mx, nn):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    for i, p in enumerate(net.collect_params().values()):
+        n = int(np.prod(p.shape))
+        p.set_data(mx.nd.array(
+            (np.arange(n, dtype=np.float32) / 10 + i).reshape(p.shape)))
+    return net
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")   # artifacts are
+    # device-agnostic; generate without touching an accelerator
+    import mxtpu as mx
+    from mxtpu.gluon import nn
+
+    os.makedirs(HERE, exist_ok=True)
+
+    # 1) .params (Block.save_parameters codec)
+    dense_net(mx, nn).save_parameters(os.path.join(HERE, "net.params"))
+
+    # 2) nd.save container (magic 0x112 little-endian header)
+    mx.nd.save(os.path.join(HERE, "arrays.bin"), {
+        "w": mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4)),
+        "idx": mx.nd.array(np.arange(5, dtype=np.int32), dtype="int32"),
+    })
+
+    # 3) orbax checkpoint of a TrainState-shaped pytree
+    from mxtpu import checkpoint
+    state = {
+        "params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                   "b": np.full((3,), 7.0, np.float32)},
+        "step": np.int32(42),
+    }
+    checkpoint.save_state(os.path.join(HERE, "ckpt"), state)
+    print(f"wrote {VERSION} artifacts under {HERE}")
+
+
+if __name__ == "__main__":
+    main()

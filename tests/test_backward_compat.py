@@ -1,0 +1,52 @@
+"""Backward compatibility: artifacts SAVED by round 5 must keep
+loading, bit-for-bit, in every later round (reference
+``tests/nightly/model_backwards_compatibility_check`` [path cite —
+unverified]). The committed artifacts under ``tests/artifacts/r5/``
+were produced by ``tests/artifacts/make_artifacts.py`` — regenerate
+and re-commit ONLY on an intentional format change."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu.gluon import nn
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts", "r5")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(HERE), reason="artifacts not generated")
+
+
+def test_r5_params_loads():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.load_parameters(os.path.join(HERE, "net.params"))
+    for i, p in enumerate(net.collect_params().values()):
+        n = int(onp.prod(p.shape))
+        want = (onp.arange(n, dtype=onp.float32) / 10 + i) \
+            .reshape(p.shape)
+        onp.testing.assert_array_equal(p.data().asnumpy(), want)
+
+
+def test_r5_nd_save_container_loads():
+    loaded = mx.nd.load(os.path.join(HERE, "arrays.bin"))
+    onp.testing.assert_array_equal(
+        loaded["w"].asnumpy(),
+        onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    assert str(loaded["idx"].dtype) == "int32"
+    onp.testing.assert_array_equal(loaded["idx"].asnumpy(),
+                                   onp.arange(5, dtype=onp.int32))
+
+
+def test_r5_orbax_checkpoint_restores():
+    from mxtpu import checkpoint
+    state = checkpoint.load_state(os.path.join(HERE, "ckpt"))
+    onp.testing.assert_array_equal(
+        onp.asarray(state["params"]["w"]),
+        onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    onp.testing.assert_array_equal(onp.asarray(state["params"]["b"]),
+                                   onp.full((3,), 7.0, onp.float32))
+    assert int(onp.asarray(state["step"])) == 42

@@ -1,0 +1,49 @@
+"""Docs must EXECUTE (VERDICT r4 #1 of 'execute everything'): every
+fenced ```python block in docs/*.md runs, in order, in one namespace
+per document — the analogue of the reference's
+``tests/tutorials/test_tutorials.py``, which ran every tutorial's code
+in CI precisely because prose rots. A new doc with python blocks
+auto-enrolls via the glob."""
+import glob
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _docs_with_blocks():
+    out = []
+    for path in sorted(glob.glob(os.path.join(REPO, "docs", "*.md"))):
+        blocks = re.findall(r"```python\n(.*?)```", open(path).read(),
+                            re.S)
+        if blocks:
+            out.append((os.path.basename(path), blocks))
+    return out
+
+
+DOCS = _docs_with_blocks()
+
+
+def test_docs_inventory():
+    """The runner must actually cover the flagship guide — if the
+    extraction regex rots, this fails rather than silently running
+    nothing."""
+    names = [n for n, _ in DOCS]
+    assert "parallelism.md" in names, names
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,blocks", DOCS, ids=[n for n, _ in DOCS])
+def test_docs_snippets_execute(name, blocks):
+    """Blocks run SEQUENTIALLY in one shared namespace (a doc is a
+    tutorial: later blocks may use earlier blocks' names)."""
+    ns = {"__name__": f"docs_{name.replace('.', '_')}"}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, f"{name}[block {i}]", "exec"), ns)
+        except Exception as e:
+            pytest.fail(f"{name} block {i} failed: {e!r}\n--- block:\n"
+                        f"{block}")

@@ -756,6 +756,124 @@ def test_gluon_llama_moe_on_ep_mesh():
     assert out.shape == (4, 10)
 
 
+def test_gluon_llama_moe_with_ring_attention_on_sp_ep_mesh():
+    """VERDICT r4 #6a: MoE must COMPOSE with sequence parallelism —
+    expert dispatch (static-capacity einsum over ep) running inside
+    the same program as ring attention (ppermute over sp). Checks:
+    ring×MoE numerics == dense×MoE numerics, the Gluon fused step
+    reproduces the functional trajectory exactly, and training moves."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    base = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                   remat=False, moe_experts=4, moe_top_k=2,
+                   moe_capacity=4.0)
+    cfg_ring = replace(base, attn_impl="ring")
+    cfg_dense = replace(base, attn_impl="dense")
+    rules = llama.sharding_rules(cfg_ring)
+    params = llama.init_params(cfg_ring, jax.random.PRNGKey(21))
+    tokens = jax.random.randint(jax.random.PRNGKey(22), (4, 32), 0,
+                                base.vocab_size)
+    lr = 0.05
+    mesh = pmesh.create_mesh(sp=2, ep=2, tp=2)
+
+    # functional MoE×ring trajectory on the sp×ep×tp mesh
+    state = pstep.init_state(params, optax.sgd(lr), mesh, rules)
+    fstep = pstep.make_train_step(llama.loss_fn(cfg_ring, mesh),
+                                  optax.sgd(lr), mesh, rules)
+    f_losses = []
+    for _ in range(3):
+        state, loss = fstep(state, {"tokens": tokens})
+        f_losses.append(float(loss))
+    assert f_losses[-1] < f_losses[0]          # it trains
+
+    # ring attention must not change the math: dense×MoE on the same
+    # mesh, same params, same first loss (float32 tolerance)
+    state_d = pstep.init_state(params, optax.sgd(lr), mesh, rules)
+    dstep = pstep.make_train_step(llama.loss_fn(cfg_dense, mesh),
+                                  optax.sgd(lr), mesh, rules)
+    _, loss_d = dstep(state_d, {"tokens": tokens})
+    np.testing.assert_allclose(float(loss_d), f_losses[0], rtol=2e-5)
+
+    # the Gluon fused step reproduces the functional MoE×ring numbers
+    net = GluonLlama(cfg_ring)
+    net.load_pytree(params)
+    net.hybridize()
+    net.shard(mesh, rules)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": lr, "wd": 0.0})
+    fused = tr.make_fused_step(net)
+    tok_nd = mx.nd.array(np.asarray(tokens))
+    g_losses = [float(fused(tok_nd, tok_nd).asscalar())
+                for _ in range(3)]
+    np.testing.assert_allclose(g_losses, f_losses, rtol=1e-6, atol=1e-7)
+
+
+def test_gluon_llama_moe_fused_grad_accum_dynamic_amp():
+    """VERDICT r4 #6b: MoE through make_fused_step with grad_accum>1
+    AND dynamic AMP — precisely where static-capacity dispatch, the
+    scan-threaded microbatch loop, and the in-program overflow
+    decision could interact badly. A forced overflow must skip
+    cleanly: the AMP run's applied steps reproduce the no-AMP run's
+    trajectory (skipped step never happened), with expert banks
+    really ep-sharded throughout."""
+    from mxtpu import amp
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                  attn_impl="dense", remat=False, moe_experts=4,
+                  moe_top_k=2, moe_capacity=4.0)
+    rules = llama.sharding_rules(cfg)
+    params = llama.init_params(cfg, jax.random.PRNGKey(31))
+    tokens = jax.random.randint(jax.random.PRNGKey(32), (4, 24), 0,
+                                cfg.vocab_size)
+    tok_nd = mx.nd.array(np.asarray(tokens))
+    mesh = pmesh.create_mesh(dp=2, ep=2, tp=2)
+    lr = 0.05
+
+    def build(with_amp):
+        net = GluonLlama(cfg)
+        net.load_pytree(params)
+        net.hybridize()
+        net.shard(mesh, rules)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": lr, "wd": 0.0,
+                            "momentum": 0.9})
+        if with_amp:
+            amp.init("float16")
+            amp.init_trainer(tr)
+            tr._amp_loss_scaler.loss_scale = 1e38   # clamps to 2^126;
+            # forces an overflow on step 1
+        return net, tr.make_fused_step(net, grad_accum=2)
+
+    STEPS = 8          # scale must walk down from 2^126 to this
+    # model's finite range (several halvings), then train
+    net_a, fused_a = build(with_amp=True)
+    a_losses = [float(fused_a(tok_nd, tok_nd).asscalar())
+                for _ in range(STEPS)]
+    applied = fused_a.applied_updates()
+    assert 1 <= applied < STEPS                # skips happened, then ran
+    assert fused_a.num_compiles() == 1         # AMP+accum in-program
+    # while skipping, the loss cannot move
+    assert a_losses[1] == pytest.approx(a_losses[0], rel=1e-6)
+
+    net_n, fused_n = build(with_amp=False)
+    n_losses = [float(fused_n(tok_nd, tok_nd).asscalar())
+                for _ in range(applied)]
+    # the AMP run's applied steps ARE the no-AMP trajectory: losses
+    # observed at skip-adjusted offsets match (momentum included)
+    np.testing.assert_allclose(a_losses[STEPS - applied:],
+                               n_losses, rtol=2e-5, atol=1e-6)
+    for pa, pn in zip(net_a.collect_params().values(),
+                      net_n.collect_params().values()):
+        np.testing.assert_allclose(
+            pa.data().asnumpy(), pn.data().asnumpy(),
+            rtol=2e-4, atol=1e-6, err_msg=pa.name)
+    # the expert bank stayed ep-sharded through the AMP+accum program
+    wg = net_a._reg_params["layers_w_gate"].data()._data
+    assert wg.sharding.shard_shape(wg.shape)[1] == 2   # E=4 over ep2
+
+
 def test_gluon_llama_generate_and_save_load(tmp_path):
     """The Gluon surface composes: generate() (KV cache) works off the
     block's weights, and save/load_parameters round-trips them."""

@@ -1,6 +1,7 @@
 """Tools tests: parse_log, launch.py local tracker + dist kvstore
 invariants (the reference's tests/nightly/dist_sync_kvstore.py pattern:
 the local tracker forks workers on one host, SURVEY.md §4.2)."""
+import glob
 import os
 import subprocess
 import sys
@@ -611,27 +612,84 @@ def test_dist_compressed_allreduce_packed_wire(tmp_path):
     assert out.stdout.count("COMPOK") == 2
 
 
+# -- the example gate: EVERY script under example/ runs (VERDICT r4
+# #2: 8 of 18 suites were never executed and could rot invisibly).
+# The walker globs example/**/*.py so new suites AUTO-ENROLL; per-
+# script argv here only shrinks shapes for CI (scripts must pass with
+# plain defaults on real hardware). MXTPU_SMOKE=1 is the walker-wide
+# convention for scripts whose smallness knob isn't an argv flag.
+_EXAMPLE_ARGV = {
+    "example/bert/pretrain.py": ["--steps", "4", "--batch-size", "8",
+                                 "--seq-len", "64"],
+    "example/gluon/mnist.py": ["--epochs", "1", "--batch-size", "64"],
+    "example/image-classification/benchmark_score.py":
+        ["--models", "squeezenet1.1", "--batch", "2", "--size", "64"],
+    "example/sparse/linear_classification.py":
+        ["--epochs", "2", "--dim", "200"],
+}
+# scripts that are multi-process entry points: run under launch.py -n 2
+_EXAMPLE_LAUNCHED = {"example/distributed_training/train_dist.py"}
+
+
+def _example_scripts():
+    repo = os.path.abspath(REPO)
+    pats = os.path.join(repo, "example", "**", "*.py")
+    return sorted(
+        os.path.relpath(p, repo).replace(os.sep, "/")
+        for p in glob.glob(pats, recursive=True)
+        if "__pycache__" not in p)
+
+
+def test_example_walker_sees_known_suites():
+    """If the glob rots, fail loudly instead of silently gating
+    nothing."""
+    scripts = _example_scripts()
+    assert len(scripts) >= 19, scripts
+    assert "example/moe/train_moe.py" in scripts
+    for k in list(_EXAMPLE_ARGV) + list(_EXAMPLE_LAUNCHED):
+        assert k in scripts, f"stale config entry {k}"
+
+
 @pytest.mark.slow
-def test_example_scripts_smoke():
-    """New example suites run end-to-end on the CPU mesh."""
-    for script in ("example/autograd/custom_function.py",
-                   "example/kvstore/async_ps.py",
-                   "example/pipeline_parallel/gpipe_demo.py",
-                   "example/ssd/train_ssd.py",
-                   "example/rnn/bucketing/bucketing_lstm.py",
-                   "example/amp/train_amp.py",
-                   "example/moe/train_moe.py",
-                   "example/inference/serve_llama.py",
-                   "example/checkpoint/resume_training.py"):
-        out = subprocess.run(
-            [sys.executable, os.path.join(REPO, script)],
-            capture_output=True, text=True, timeout=300,
-            env={**os.environ, "JAX_PLATFORMS": "cpu",
-                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-                 "MXTPU_PS_PORT_OFFSET": "31",
-                 "PYTHONPATH": REPO + os.pathsep +
-                 os.environ.get("PYTHONPATH", "")})
-        assert out.returncode == 0, (script, out.stderr[-1200:])
+@pytest.mark.parametrize("script", _example_scripts())
+def test_example_scripts_smoke(script):
+    """Every example suite runs end-to-end on the CPU mesh."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "MXTPU_PS_PORT_OFFSET": "31", "MXTPU_SMOKE": "1",
+           "PYTHONPATH": REPO + os.pathsep +
+           os.environ.get("PYTHONPATH", "")}
+    if script in _EXAMPLE_LAUNCHED:
+        cmd = [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+               "--env", "JAX_PLATFORMS=cpu", "--",
+               sys.executable, os.path.join(REPO, script)]
+    else:
+        cmd = [sys.executable, os.path.join(REPO, script)] + \
+            _EXAMPLE_ARGV.get(script, [])
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=600, env=env)
+    assert out.returncode == 0, (script, out.stdout[-600:],
+                                 out.stderr[-1200:])
+
+
+@pytest.mark.slow
+def test_bandwidth_probe_runs_on_virtual_mesh():
+    """VERDICT r4 weak #6: the psum-sweep measurement path must
+    EXECUTE on the virtual 8-device mesh (harness correctness — the
+    GB/s number is meaningless on CPU, but the shard_map/fori_loop/
+    fence machinery must not be dead code until real multi-chip)."""
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "bandwidth", "measure.py"),
+         "--sizes", "0.25,1", "--iters", "3"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "PYTHONPATH": REPO + os.pathsep +
+             os.environ.get("PYTHONPATH", "")})
+    assert out.returncode == 0, out.stderr[-1200:]
+    assert out.stdout.count("busbw") == 2, out.stdout
+    assert "CpuDevice" in out.stdout         # really on the CPU mesh
 
 
 def test_launch_sge_emits_script(tmp_path):

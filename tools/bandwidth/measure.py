@@ -72,5 +72,11 @@ if __name__ == "__main__":
     p.add_argument("--sizes", default="1,4,16,64,256")
     p.add_argument("--iters", type=int, default=10)
     a = p.parse_args()
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # the ambient sitecustomize force-registers the TPU plugin and
+        # overrides the env var; the config update wins (conftest
+        # recipe) — lets the probe run on the virtual 8-device mesh
+        jax.config.update("jax_platforms", "cpu")
     print(f"devices: {jax.devices()}")
     measure([float(s) for s in a.sizes.split(",")], a.iters)
