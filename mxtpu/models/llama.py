@@ -42,7 +42,8 @@ from ..parallel.sharding import mcon as _mcon
 __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "loss_fn", "chunked_softmax_xent", "sharding_rules",
            "CONFIGS", "init_cache", "cache_specs", "prefill",
-           "decode_step", "generate"]
+           "chunked_prefill", "decode_step", "generate",
+           "quantize_params_int8", "int8_sharding_rules"]
 
 
 @dataclass(frozen=True)
@@ -291,9 +292,9 @@ def _ffn(cfg: LlamaConfig, lp, h, mesh, serving: bool = False):
                                capacity_factor=cfg.moe_capacity,
                                mesh=mesh)
         return out.reshape(b, s, d), aux
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    return (gate * up) @ lp["w_down"].astype(dt), \
+    gate = jax.nn.silu(h @ _wq8(lp["w_gate"], dt))
+    up = h @ _wq8(lp["w_up"], dt)
+    return (gate * up) @ _wq8(lp["w_down"], dt), \
         jnp.zeros((), jnp.float32)
 
 
@@ -341,6 +342,75 @@ def forward_hidden(cfg: LlamaConfig, params, tokens,
 def _head(cfg: LlamaConfig, params):
     return (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
+
+
+def _wq8(w, dt):
+    """Serving weight loader: a raw array, or a weight-only int8 dict
+    ``{'q8': int8, 's8': f32 per-out-channel}`` (see
+    :func:`quantize_params_int8`). The dequant multiply is in-program;
+    XLA fuses it into the consuming matmul's operand read, so int8
+    halves the HBM weight traffic that dominates small-batch decode."""
+    if isinstance(w, dict):
+        return w["q8"].astype(dt) * w["s8"].astype(dt)
+    return w.astype(dt)
+
+
+def quantize_params_int8(cfg: LlamaConfig, params):
+    """Weight-only int8 quantization for SERVING (prefill/decode/
+    generate — the cached path; the training forward does not consume
+    quantized trees). Symmetric per-output-channel scales over the
+    contracted axis: ``w ≈ q8 · s8`` with q8 ∈ [-127, 127] int8 and
+    s8 = max|w| / 127 per output column. Activations, norms, and the
+    KV cache stay in ``cfg.dtype`` — this is the regime analysis of
+    docs/perf.md ("int8 serving becomes interesting only where
+    weights dominate the step time — multi-GB models at small
+    batch"): llama3_8b tp8 decode. Shard with
+    :func:`int8_sharding_rules`."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "int8 serving quantization covers dense configs; the MoE "
+            "expert banks serve via the dense-mixture path in bf16")
+
+    def q(w):
+        s = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                    keepdims=True) / 127.0
+        s = jnp.maximum(s, 1e-12)
+        q8 = jnp.clip(jnp.round(w.astype(jnp.float32) / s),
+                      -127, 127).astype(jnp.int8)
+        return {"q8": q8, "s8": s.astype(jnp.float32)}
+
+    out = {"tok_embed": q(params["tok_embed"]),
+           "final_norm": params["final_norm"],
+           "layers": dict(params["layers"])}
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        out["layers"][name] = q(params["layers"][name])
+    if "lm_head" in params:
+        out["lm_head"] = q(params["lm_head"])
+    return out
+
+
+def int8_sharding_rules(cfg: Optional[LlamaConfig] = None) \
+        -> ShardingRules:
+    """Placement for :func:`quantize_params_int8` trees: q8 leaves
+    inherit their weight's Megatron spec; s8 scales (size-1 on every
+    axis but the output channels) shard only the output axis."""
+    L = None
+    return ShardingRules([
+        (r"tok_embed/q8$",        P("tp", "fsdp")),
+        (r"tok_embed/s8$",        P(None, "fsdp")),
+        (r"layers/w[qkv]/q8$",    P(L, "fsdp", "tp")),
+        (r"layers/w[qkv]/s8$",    P(L, None, "tp")),
+        (r"layers/wo/q8$",        P(L, "tp", "fsdp")),
+        (r"layers/wo/s8$",        P(L, None, "fsdp")),
+        (r"layers/w_(gate|up)/q8$", P(L, "fsdp", "tp")),
+        (r"layers/w_(gate|up)/s8$", P(L, None, "tp")),
+        (r"layers/w_down/q8$",    P(L, "tp", "fsdp")),
+        (r"layers/w_down/s8$",    P(L, None, "fsdp")),
+        (r"lm_head/q8$",          P("fsdp", "tp")),
+        (r"lm_head/s8$",          P(None, "tp")),
+        (r"norm",                 P()),
+        (r".*",                   P()),
+    ])
 
 
 def forward(cfg: LlamaConfig, params, tokens,
@@ -524,9 +594,9 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
     dt = cfg.dtype
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ lp["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ lp["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = (h @ _wq8(lp["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ _wq8(lp["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ _wq8(lp["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
     q = q.transpose(0, 2, 1, 3)          # (b, h, s, hd)
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
@@ -571,7 +641,7 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
     o = jnp.einsum("bgrsk,bgkd->bgrsd", p, cv)
     o = o.reshape(b, cfg.n_heads, s, hd)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-    x = x + _mcon(mesh, o @ lp["wo"].astype(dt),
+    x = x + _mcon(mesh, o @ _wq8(lp["wo"], dt),
                   batch_ax, None, None)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
@@ -602,7 +672,13 @@ def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
     if kvspec is not None:               # per-layer view: drop the
         kvspec = P(*kvspec[1:])          # scanned leading L axis
     batch_ax = kvspec[0] if kvspec is not None else ("dp", "fsdp")
-    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    emb = params["tok_embed"]
+    if isinstance(emb, dict):        # weight-only int8: dequant the
+        # GATHERED rows only (scale is per-dim-channel)
+        x = emb["q8"][tokens].astype(cfg.dtype) * \
+            emb["s8"][0].astype(cfg.dtype)
+    else:
+        x = emb[tokens].astype(cfg.dtype)
     x = _mcon(mesh, x, batch_ax, None, None)
     # rope tables for absolute positions pos..pos+s from one static
     # (max_len, hd/2) table — keeps the program shape-static
@@ -629,8 +705,9 @@ def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if last_only:
         x = x[:, -1:]
-    logits = jnp.einsum("bsd,dv->bsv", x,
-                        _head(cfg, params).astype(cfg.dtype),
+    hw = (_wq8(params["tok_embed"], cfg.dtype).T if cfg.tie_embeddings
+          else _wq8(params["lm_head"], cfg.dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, hw,
                         preferred_element_type=jnp.float32)
     logits = _mcon(mesh, logits, batch_ax, None, None)
     new_cache = {"k": ck, "v": cv, "pos": pos + s}
@@ -646,6 +723,57 @@ def prefill(cfg: LlamaConfig, params, tokens, cache,
     (8×2048×128256 f32 ≈ 8.4GB, vs ~0.004GB for the last position)."""
     return _forward_cached(cfg, params, tokens, cache, mesh=mesh,
                            last_only=last_only)
+
+
+def chunked_prefill(cfg: LlamaConfig, params, tokens, cache,
+                    chunk_size: int, mesh: Optional[Mesh] = None):
+    """Streaming prefill (VERDICT r4 #5 — the long-context serving
+    half): run the prompt through the cached stack in ``chunk_size``
+    slices via one ``lax.scan``, so peak activation memory scales
+    with the CHUNK, not the prompt. Single-shot prefill materializes
+    per-layer attention logits of (b, h, s, ctx) f32 — at llama3_8b
+    with a 32k prompt that is ~1 TB and cannot compile; chunked at
+    1k it is ~34 GB/layer-step sharded over tp. Only the final
+    position's logits are computed per chunk (s=1 head matmul), and
+    only the last chunk's survive.
+
+    Prompt lengths that don't divide ``chunk_size`` are handled by a
+    trailing remainder pass (a second compiled shape) — NEVER pad the
+    prompt: the cached path has no pad masking, so pad tokens would
+    occupy real cache slots and shift every RoPE position.
+
+    Returns (logits (b, 1, V) f32 for the last prompt position,
+    cache) — exactly ``prefill(..., last_only=True)``
+    (``test_llama_chunked_prefill_matches_single_shot``)."""
+    b, s = tokens.shape
+    n, rem = divmod(s, chunk_size)
+    logits = None
+    if n == 1 and rem == 0:
+        return _forward_cached(cfg, params, tokens, cache,
+                               last_only=True, mesh=mesh)
+    if n:
+        # (b, n·c) → (n, b, c): scan consumes the leading axis. The
+        # per-chunk logits ride in the CARRY (same (b, 1, V) shape
+        # every step), not the stacked scan output — stacking n
+        # last-position logits would buffer n·b·V f32 (~123 MB at
+        # 32k/llama3_8b) only to keep one slice
+        chunks = tokens[:, :n * chunk_size] \
+            .reshape(b, n, chunk_size).transpose(1, 0, 2)
+
+        def body(carry, chunk):
+            cache, _ = carry
+            lg, cache = _forward_cached(cfg, params, chunk, cache,
+                                        last_only=True, mesh=mesh)
+            return (cache, lg), None
+
+        zeros = jnp.zeros((b, 1, cfg.vocab_size), jnp.float32)
+        (cache, logits), _ = lax.scan(body, (cache, zeros), chunks)
+    if rem:
+        logits, cache = _forward_cached(cfg, params,
+                                        tokens[:, n * chunk_size:],
+                                        cache, last_only=True,
+                                        mesh=mesh)
+    return logits, cache
 
 
 def decode_step(cfg: LlamaConfig, params, token, cache,

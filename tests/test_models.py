@@ -233,6 +233,159 @@ def test_llama_sharded_decode_matches_single_device(tiny_cfg):
                                   np.asarray(ref_tokens))
 
 
+def test_llama_int8_decode_matches_dequantized_float(tiny_cfg):
+    """VERDICT r4 #4: weight-only int8 serving. The in-program dequant
+    path must equal running the float path on MANUALLY dequantized
+    weights (same math, so tight tolerance), stay CLOSE to the bf16/
+    f32 original (bounded quantization error), and generate end to
+    end."""
+    cfg = replace(tiny_cfg, dtype=jnp.float32, remat=False,
+                  attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    qparams = llama.quantize_params_int8(cfg, params)
+    assert qparams["layers"]["wq"]["q8"].dtype == jnp.int8
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 12), 0,
+                                cfg.vocab_size)
+
+    # manual dequant -> the existing float serving path (any depth)
+    fparams = jax.tree.map(
+        lambda v: (v["q8"].astype(jnp.float32) * v["s8"]
+                   if isinstance(v, dict) and "q8" in v else v),
+        qparams,
+        is_leaf=lambda v: isinstance(v, dict) and "q8" in v)
+
+    cache_q = llama.init_cache(cfg, 2, 16)
+    cache_f = llama.init_cache(cfg, 2, 16)
+    lq, _ = llama.prefill(cfg, qparams, prompt, cache_q,
+                          last_only=True)
+    lf, _ = llama.prefill(cfg, fparams, prompt, cache_f,
+                          last_only=True)
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
+                               rtol=1e-5, atol=1e-5)
+
+    # bounded quantization error vs the unquantized original
+    cache_o = llama.init_cache(cfg, 2, 16)
+    lo, _ = llama.prefill(cfg, params, prompt, cache_o,
+                          last_only=True)
+    err = np.abs(np.asarray(lq) - np.asarray(lo))
+    scale = np.abs(np.asarray(lo)).max()
+    assert err.max() / scale < 0.05, err.max() / scale
+
+    # end-to-end generation off the quantized tree
+    out = jax.jit(
+        lambda p, t: llama.generate(cfg, p, t, 5))(qparams, prompt)
+    assert out.shape == (2, 17)
+
+
+def test_llama_chunked_prefill_matches_single_shot(tiny_cfg):
+    """VERDICT r4 #5: streaming prefill. Chunked must equal one-shot
+    prefill(last_only=True) — logits AND the full cache — and feed a
+    decode that continues identically."""
+    cfg = replace(tiny_cfg, dtype=jnp.float32, remat=False,
+                  attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.PRNGKey(15))
+    prompt = jax.random.randint(jax.random.PRNGKey(16), (2, 24), 0,
+                                cfg.vocab_size)
+
+    c_ref = llama.init_cache(cfg, 2, 32)
+    lg_ref, c_ref = llama.prefill(cfg, params, prompt, c_ref,
+                                  last_only=True)
+    for chunk in (24, 12, 8, 4):          # incl. the n==1 fast path
+        c = llama.init_cache(cfg, 2, 32)
+        lg, c = llama.chunked_prefill(cfg, params, prompt, c, chunk)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(lg_ref),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"chunk={chunk}")
+        np.testing.assert_allclose(np.asarray(c["k"]),
+                                   np.asarray(c_ref["k"]),
+                                   rtol=2e-5, atol=2e-5)
+        assert int(c["pos"]) == 24
+    # a decode step off the chunked cache continues the sequence
+    tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    d1, _ = llama.decode_step(cfg, params, tok, c)
+    d2, _ = llama.decode_step(cfg, params, tok, c_ref)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                               rtol=2e-5, atol=2e-5)
+    # ragged prompts: 24 = 3×7 + 3 runs full chunks + a remainder
+    # pass (padding would corrupt the cache/RoPE — never pad)
+    cr = llama.init_cache(cfg, 2, 32)
+    lg_r, cr = llama.chunked_prefill(cfg, params, prompt, cr, 7)
+    np.testing.assert_allclose(np.asarray(lg_r), np.asarray(lg_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cr["k"]),
+                               np.asarray(c_ref["k"]),
+                               rtol=2e-5, atol=2e-5)
+    assert int(cr["pos"]) == 24
+
+
+def test_llama_chunked_prefill_sharded(tiny_cfg):
+    """Chunked prefill on the serving mesh: the scanned cache carry
+    must keep its kv-head/batch sharding chunk to chunk."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from jax.sharding import NamedSharding
+    from mxtpu.parallel.sharding import shard_pytree
+
+    cfg = replace(tiny_cfg, dtype=jnp.float32, remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(17))
+    prompt = jax.random.randint(jax.random.PRNGKey(18), (4, 16), 0,
+                                cfg.vocab_size)
+    ref_c = llama.init_cache(cfg, 4, 24)
+    ref_lg, ref_c = llama.prefill(cfg, params, prompt, ref_c,
+                                  last_only=True)
+
+    mesh = pmesh.create_mesh(dp=2, fsdp=2, tp=2)
+    sparams = shard_pytree(params, mesh, llama.sharding_rules(cfg))
+    sprompt = jax.device_put(
+        prompt, NamedSharding(mesh, P(("dp", "fsdp"))))
+    cache = llama.init_cache(cfg, 4, 24, mesh=mesh)
+    kv_sharding = cache["k"].sharding
+    lg, cache = jax.jit(
+        lambda p, t, c: llama.chunked_prefill(cfg, p, t, c, 4,
+                                              mesh=mesh))(
+        sparams, sprompt, cache)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(ref_lg),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(cache["k"]),
+                               np.asarray(ref_c["k"]),
+                               rtol=2e-4, atol=2e-4)
+    assert cache["k"].sharding.is_equivalent_to(kv_sharding, 5), \
+        "chunked prefill lost the cache sharding"
+
+
+def test_llama_int8_sharded_decode_on_tp_mesh(tiny_cfg):
+    """int8 serving composes with the tp mesh: quantized q8/s8 leaves
+    place by int8_sharding_rules, the sharded quantized generate
+    matches the single-device quantized generate token-for-token, and
+    the expert... (dense config) cache stays kv-head-sharded."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from jax.sharding import NamedSharding
+    from mxtpu.parallel.sharding import shard_pytree
+
+    cfg = replace(tiny_cfg, dtype=jnp.float32, remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(9))
+    qparams = llama.quantize_params_int8(cfg, params)
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (4, 8), 0,
+                                cfg.vocab_size)
+    ref = jax.jit(
+        lambda p, t: llama.generate(cfg, p, t, 5))(qparams, prompt)
+
+    mesh = pmesh.create_mesh(dp=2, fsdp=2, tp=2)
+    rules = llama.int8_sharding_rules(cfg)
+    sq = shard_pytree(qparams, mesh, rules)
+    # the int8 bank really shards: wq (L, dim, out) over fsdp x tp
+    wq = sq["layers"]["wq"]["q8"]
+    assert wq.sharding.shard_shape(wq.shape)[1] == wq.shape[1] // 2
+    assert wq.sharding.shard_shape(wq.shape)[2] == wq.shape[2] // 2
+    sprompt = jax.device_put(
+        prompt, NamedSharding(mesh, P(("dp", "fsdp"))))
+    out = jax.jit(
+        lambda p, t: llama.generate(cfg, p, t, 5, mesh=mesh))(
+        sq, sprompt)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_llama_causality(tiny_cfg):
     """Changing a future token must not change past logits."""
     cfg = replace(tiny_cfg, dtype=jnp.float32, attn_impl="dense")
