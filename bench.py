@@ -219,12 +219,15 @@ def bench_aot8b():
 # -- shared AOT scaffolding (one copy: all three gates must build the
 # abstract sharded state the same way or they'd measure different
 # things) ----------------------------------------------------------------
-def _abs_sharded_params(cfg, mesh):
-    """eval_shape'd params with rule-table NamedShardings attached."""
+def _abs_sharded_params(cfg, mesh, builder=None, rules=None):
+    """eval_shape'd params with rule-table NamedShardings attached —
+    the ONE recipe every AOT gate builds its abstract tree with
+    (pass builder/rules for non-default trees, e.g. the int8 gate)."""
     from mxtpu.models import llama
-    rules = llama.sharding_rules(cfg)
+    rules = rules if rules is not None else llama.sharding_rules(cfg)
+    builder = builder or (lambda: llama.init_params(cfg))
     from jax.sharding import NamedSharding
-    abs_p = jax.eval_shape(lambda: llama.init_params(cfg))
+    abs_p = jax.eval_shape(builder)
     return jax.tree.map(
         lambda l, s: jax.ShapeDtypeStruct(
             l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
@@ -382,15 +385,11 @@ def _aot8b_int8_impl(batch=8):
     mesh = pmesh.create_mesh(tp=8)
     ctx = cfg.max_seq_len
     t0 = time.perf_counter()
-    rules = llama.int8_sharding_rules(cfg)
-    abs_q = jax.eval_shape(
-        lambda: llama.quantize_params_int8(
-            cfg, llama.init_params(cfg)))
-    abs_q = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(
-            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
-        abs_q, rules.tree_specs(abs_q),
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    abs_q, _ = _abs_sharded_params(
+        cfg, mesh,
+        builder=lambda: llama.quantize_params_int8(
+            cfg, llama.init_params(cfg)),
+        rules=llama.int8_sharding_rules(cfg))
     _, abs_tok, abs_cache = _abs_decode_args(cfg, mesh, batch, ctx)
     step = jax.jit(partial(llama.decode_step, cfg, mesh=mesh),
                    donate_argnums=(2,))

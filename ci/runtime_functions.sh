@@ -81,7 +81,8 @@ opperf_gate() {
     # baseline (upstream benchmark/opperf was a perf harness, not a
     # checklist). On a box with a real chip the sweep runs on the chip
     # and compares against benchmark/opperf/baseline_tpu.json
-    # (tolerance 2x, ops >= 0.5 ms, violators re-timed twice);
+    # (tunnel-aware: tolerance 2.5x on ops with >= 50 ms compute
+    # portion, violators re-timed twice — see the cmd flags below);
     # CPU-only boxes gate coverage alone — CPU latencies at --iters 2
     # are noise. Refresh the baseline on intentional change with
     # `ci/runtime_functions.sh opperf_baseline`.

@@ -355,9 +355,9 @@ def test_llama_chunked_prefill_sharded(tiny_cfg):
 
 def test_llama_int8_sharded_decode_on_tp_mesh(tiny_cfg):
     """int8 serving composes with the tp mesh: quantized q8/s8 leaves
-    place by int8_sharding_rules, the sharded quantized generate
-    matches the single-device quantized generate token-for-token, and
-    the expert... (dense config) cache stays kv-head-sharded."""
+    place by int8_sharding_rules (the int8 bank really shards over
+    fsdp x tp) and the sharded quantized generate matches the
+    single-device quantized generate token-for-token."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 (virtual) devices")
     from jax.sharding import NamedSharding
