@@ -24,8 +24,5 @@ def __getattr__(name):
             "mxtpu.contrib.summary" if name == "tensorboard"
             else f"mxtpu.contrib.{name}")
     if name == "onnx":
-        raise AttributeError(
-            "ONNX import/export is not available in this build (no onnx "
-            "runtime in the environment); use HybridBlock.export / "
-            "SymbolBlock.imports for model interchange")
+        return importlib.import_module("mxtpu.contrib.onnx")
     raise AttributeError(f"module 'mxtpu.contrib' has no attribute {name!r}")

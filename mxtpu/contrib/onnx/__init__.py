@@ -1,0 +1,124 @@
+"""ONNX interchange for mxtpu — export Symbol/HybridBlock graphs to
+ONNX and import ONNX models back.
+
+Rebuild of the reference's ``python/mxnet/contrib/onnx/`` (mx2onnx +
+onnx2mx) [path cite — unverified], with one environment-driven
+difference: the ``onnx`` pip package is not available here, so the ONNX
+IR schema ships with this package (``onnx.proto``, transcribed from the
+public spec) and is compiled locally — see README.md in this directory
+for what that does and does not validate.
+
+Public surface (mirrors the reference):
+- ``export_model(sym, params, input_shapes, onnx_file)`` → path
+- ``import_model(model_file)`` → (sym, arg_params, aux_params)
+- ``import_to_gluon(model_file, ctx=None)`` → SymbolBlock
+- ``get_model_metadata(model_file)`` → input/output shapes
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import onnx_pb2
+from ._export import export_graph, make_tensor, tensor_to_np
+from ._import import import_graph
+
+__all__ = ["export_model", "import_model", "import_to_gluon",
+           "get_model_metadata", "onnx_pb2"]
+
+
+def _normalize_shapes(sym, params, input_shapes):
+    """Accept dict or positional list of shapes for the graph inputs."""
+    if input_shapes is None:
+        return None
+    if isinstance(input_shapes, dict):
+        return {k: tuple(v) for k, v in input_shapes.items()}
+    inputs = [n for n in sym.list_inputs() if n not in params]
+    if len(inputs) != len(input_shapes):
+        raise ValueError(
+            f"{len(input_shapes)} shapes for {len(inputs)} inputs {inputs}")
+    return dict(zip(inputs, (tuple(s) for s in input_shapes)))
+
+
+def export_model(sym, params=None, input_shapes=None,
+                 onnx_file: str = "model.onnx", opset: int = 13,
+                 verbose: bool = False) -> str:
+    """Export to ONNX (reference ``onnx_mxnet.export_model``).
+
+    ``sym`` is a Symbol (with ``params`` mapping var name → NDArray) or
+    an initialized HybridBlock (traced here; ``params`` ignored).
+    ``input_shapes``: dict name → shape, or list in input order.
+    """
+    from ...gluon.block import HybridBlock
+    import mxtpu.symbol as sym_mod
+
+    if isinstance(sym, HybridBlock):
+        block = sym
+        n_in = len(input_shapes) if input_shapes is not None and \
+            not isinstance(input_shapes, dict) else 1
+        names = ["data"] if n_in == 1 else [f"data{i}" for i in range(n_in)]
+        if isinstance(input_shapes, dict):
+            names = list(input_shapes)
+        out = block._trace_symbol(*[sym_mod.var(n) for n in names])
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        sym = out
+        aux_names = set(sym.list_auxiliary_states())
+        params = {p.name: p.data() for p in block.collect_params().values()
+                  if p.name in aux_names or p.name in sym.list_arguments()}
+    params = params or {}
+    shapes = _normalize_shapes(sym, params, input_shapes)
+    model = export_graph(sym, params, input_shapes=shapes, opset=opset)
+    with open(onnx_file, "wb") as f:
+        f.write(model.SerializeToString())
+    if verbose:
+        print(f"exported {len(model.graph.node)} nodes / "
+              f"{len(model.graph.initializer)} initializers → {onnx_file}")
+    return onnx_file
+
+
+def import_model(model_file: str):
+    """ONNX file → (sym, arg_params, aux_params) (reference
+    ``onnx_mxnet.import_model``)."""
+    model = onnx_pb2.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    sym, arg_params, aux_params, _ = import_graph(model)
+    return sym, arg_params, aux_params
+
+
+def import_to_gluon(model_file: str, ctx=None):
+    """ONNX file → runnable Gluon ``SymbolBlock`` (reference
+    ``onnx_mxnet.import_to_gluon``)."""
+    import mxtpu.symbol as sym_mod
+    from ...gluon.block import SymbolBlock
+
+    model = onnx_pb2.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    sym, arg_params, aux_params, input_names = import_graph(model)
+    params = dict(arg_params)
+    params.update(aux_params)
+    block = SymbolBlock(sym, [sym_mod.var(n) for n in input_names],
+                        params=params)
+    return block
+
+
+def get_model_metadata(model_file: str) -> Dict[str, Any]:
+    """Input/output names and shapes of an ONNX file (reference
+    ``onnx_mxnet.get_model_metadata``)."""
+    model = onnx_pb2.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def vi_shape(vi):
+        tt = vi.type.tensor_type
+        return tuple(d.dim_value if d.WhichOneof("value") == "dim_value"
+                     else d.dim_param for d in tt.shape.dim)
+
+    return {
+        "input_tensor_data": [(vi.name, vi_shape(vi)) for vi in g.input
+                              if vi.name not in inits],
+        "output_tensor_data": [(vi.name, vi_shape(vi)) for vi in g.output],
+    }

@@ -1,0 +1,874 @@
+"""mxtpu → ONNX graph exporter (the mx2onnx direction).
+
+Rebuild of the reference's ``python/mxnet/contrib/onnx/mx2onnx``
+[path cite — unverified]: walk the Symbol DAG in topological order and
+emit one or more ONNX ``NodeProto`` per mxtpu op through a converter
+registry, with parameters becoming graph initializers.
+
+Design notes (TPU-first consequences):
+- The Symbol graph here is already framework-neutral — op nodes with
+  python-value attrs — so conversion is a name/attr mapping, not a
+  trace. Shapes/dtypes come from the symbol's abstract evaluation
+  (``Symbol._infer_structs``, i.e. ``jax.eval_shape`` — no kernels run
+  and nothing touches a device during export).
+- Export is inference-oriented (like the reference exporter): BatchNorm
+  uses its running stats, Dropout is the identity.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from . import onnx_pb2 as _pb
+
+# dtype name ↔ TensorProto.DataType
+_NP2ONNX = {
+    "float32": _pb.TensorProto.FLOAT,
+    "float64": _pb.TensorProto.DOUBLE,
+    "float16": _pb.TensorProto.FLOAT16,
+    "bfloat16": _pb.TensorProto.BFLOAT16,
+    "uint8": _pb.TensorProto.UINT8,
+    "int8": _pb.TensorProto.INT8,
+    "int16": _pb.TensorProto.INT16,
+    "uint16": _pb.TensorProto.UINT16,
+    "int32": _pb.TensorProto.INT32,
+    "int64": _pb.TensorProto.INT64,
+    "uint32": _pb.TensorProto.UINT32,
+    "uint64": _pb.TensorProto.UINT64,
+    "bool": _pb.TensorProto.BOOL,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def np_dtype_to_onnx(dt) -> int:
+    name = _np.dtype(dt).name if str(dt) != "bfloat16" else "bfloat16"
+    try:
+        return _NP2ONNX[name]
+    except KeyError:
+        raise ValueError(f"dtype {dt!r} has no ONNX TensorProto mapping")
+
+
+def make_tensor(name: str, arr: _np.ndarray) -> _pb.TensorProto:
+    """numpy → TensorProto with raw_data payload (little-endian, the ONNX
+    raw encoding). bfloat16 is stored as its raw 2-byte payload."""
+    t = _pb.TensorProto()
+    t.name = name
+    t.dims.extend(int(d) for d in arr.shape)
+    if str(arr.dtype) == "bfloat16":
+        t.data_type = _pb.TensorProto.BFLOAT16
+        t.raw_data = arr.tobytes()
+        return t
+    t.data_type = np_dtype_to_onnx(arr.dtype)
+    a = _np.ascontiguousarray(arr)
+    if a.dtype.byteorder == ">":
+        a = a.byteswap().view(a.dtype.newbyteorder("<"))
+    t.raw_data = a.tobytes()
+    return t
+
+
+def tensor_to_np(t: _pb.TensorProto) -> _np.ndarray:
+    """TensorProto → numpy, accepting both raw_data and the typed
+    repeated fields (both appear in the wild)."""
+    shape = tuple(t.dims)
+    if t.data_type == _pb.TensorProto.BFLOAT16:
+        try:
+            import ml_dtypes
+            dt = _np.dtype(ml_dtypes.bfloat16)
+        except ImportError:  # pragma: no cover
+            raise ValueError("bfloat16 tensor requires ml_dtypes")
+        if t.raw_data:
+            return _np.frombuffer(t.raw_data, dtype=dt).reshape(shape).copy()
+        # int32_data carries the raw 16-bit payloads per the ONNX spec
+        u16 = _np.asarray(t.int32_data, dtype=_np.uint16)
+        return u16.view(dt).reshape(shape).copy()
+    np_dt = _np.dtype(_ONNX2NP[t.data_type])
+    if t.raw_data:
+        return _np.frombuffer(t.raw_data, dtype=np_dt).reshape(shape).copy()
+    if t.data_type == _pb.TensorProto.FLOAT16:
+        # typed storage carries fp16 BIT PATTERNS in int32_data (spec),
+        # not numeric values — bitcast, don't convert
+        u16 = _np.asarray(t.int32_data, dtype=_np.uint16)
+        return u16.view(_np.float16).reshape(shape).copy()
+    if t.data_type == _pb.TensorProto.FLOAT:
+        data = t.float_data
+    elif t.data_type == _pb.TensorProto.DOUBLE:
+        data = t.double_data
+    elif t.data_type == _pb.TensorProto.INT64:
+        data = t.int64_data
+    elif t.data_type in (_pb.TensorProto.UINT32, _pb.TensorProto.UINT64):
+        data = t.uint64_data  # spec: uint32 values also ride uint64_data
+    else:  # int32 field carries every narrower integer/bool/fp16 type
+        data = t.int32_data
+    return _np.asarray(data, dtype=np_dt).reshape(shape)
+
+
+class GraphBuilder:
+    """Accumulates ONNX graph pieces while the symbol topo-walk runs."""
+
+    def __init__(self, opset: int = 13):
+        self.opset = opset
+        self.nodes: List[_pb.NodeProto] = []
+        self.initializers: List[_pb.TensorProto] = []
+        self.inputs: List[_pb.ValueInfoProto] = []
+        self.outputs: List[_pb.ValueInfoProto] = []
+        self._names_used: set = set()
+        self._struct_of: Dict[str, Any] = {}  # value name → ShapeDtypeStruct
+
+    # -- naming ---------------------------------------------------------
+    def unique(self, hint: str) -> str:
+        name, i = hint, 0
+        while name in self._names_used:
+            i += 1
+            name = f"{hint}_{i}"
+        self._names_used.add(name)
+        return name
+
+    # -- emission -------------------------------------------------------
+    def add_node(self, op_type: str, inputs: Sequence[str],
+                 outputs: Sequence[str], name: Optional[str] = None,
+                 **attrs) -> _pb.NodeProto:
+        n = _pb.NodeProto()
+        n.op_type = op_type
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        n.name = name or self.unique(op_type.lower())
+        for k, v in attrs.items():
+            n.attribute.append(self._attr(k, v))
+        self.nodes.append(n)
+        for o in outputs:
+            self._names_used.add(o)
+        return n
+
+    @staticmethod
+    def _attr(name: str, v) -> _pb.AttributeProto:
+        a = _pb.AttributeProto()
+        a.name = name
+        if isinstance(v, bool):
+            a.type = _pb.AttributeProto.INT
+            a.i = int(v)
+        elif isinstance(v, (int, _np.integer)):
+            a.type = _pb.AttributeProto.INT
+            a.i = int(v)
+        elif isinstance(v, (float, _np.floating)):
+            a.type = _pb.AttributeProto.FLOAT
+            a.f = float(v)
+        elif isinstance(v, str):
+            a.type = _pb.AttributeProto.STRING
+            a.s = v.encode()
+        elif isinstance(v, (list, tuple)):
+            if all(isinstance(x, (int, _np.integer)) for x in v):
+                a.type = _pb.AttributeProto.INTS
+                a.ints.extend(int(x) for x in v)
+            elif all(isinstance(x, (int, float, _np.floating)) for x in v):
+                a.type = _pb.AttributeProto.FLOATS
+                a.floats.extend(float(x) for x in v)
+            else:
+                raise ValueError(f"attr {name}: unsupported list {v!r}")
+        elif isinstance(v, _pb.TensorProto):
+            a.type = _pb.AttributeProto.TENSOR
+            a.t.CopyFrom(v)
+        else:
+            raise ValueError(f"attr {name}: unsupported value {v!r}")
+        return a
+
+    def add_initializer(self, hint: str, arr: _np.ndarray) -> str:
+        name = self.unique(hint)
+        self.initializers.append(make_tensor(name, _np.asarray(arr)))
+        return name
+
+    def const_like(self, hint: str, value, ref: str) -> str:
+        """Scalar constant initializer matching `ref`'s inferred dtype
+        (falls back to f32 when the dtype is unknown)."""
+        st = self._struct_of.get(ref)
+        dt = _np.dtype(st.dtype) if st is not None else _np.float32
+        return self.add_initializer(hint, _np.asarray(value, dtype=dt))
+
+    def i64(self, hint: str, values) -> str:
+        return self.add_initializer(
+            hint, _np.asarray(list(values), dtype=_np.int64))
+
+    def dtype_of(self, value_name: str):
+        st = self._struct_of.get(value_name)
+        return _np.dtype(st.dtype) if st is not None else None
+
+    def shape_of(self, value_name: str):
+        st = self._struct_of.get(value_name)
+        return tuple(st.shape) if st is not None else None
+
+    @staticmethod
+    def value_info(name: str, struct) -> _pb.ValueInfoProto:
+        vi = _pb.ValueInfoProto()
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = np_dtype_to_onnx(struct.dtype)
+        for d in struct.shape:
+            tt.shape.dim.add().dim_value = int(d)
+        return vi
+
+
+# -- converter registry ------------------------------------------------------
+_CONVERTERS: Dict[str, Callable] = {}
+
+
+def converts(*op_names):
+    def deco(fn):
+        for n in op_names:
+            _CONVERTERS[n] = fn
+        return fn
+    return deco
+
+
+def _spatial(attr, nd, default=1):
+    if attr is None:
+        return [default] * nd
+    return [int(x) for x in attr]
+
+
+def _sym_pads(pad: Sequence[int]) -> List[int]:
+    # mxtpu symmetric pad → ONNX [begin..., end...] order
+    return list(pad) + list(pad)
+
+
+@converts("Convolution")
+def _conv(b: GraphBuilder, node, ins, outs):
+    k = [int(x) for x in node.attrs["kernel"]]
+    nd = len(k)
+    b.add_node(
+        "Conv", ins, outs, name=node.name,
+        kernel_shape=k,
+        strides=_spatial(node.attrs.get("stride"), nd),
+        dilations=_spatial(node.attrs.get("dilate"), nd),
+        pads=_sym_pads(_spatial(node.attrs.get("pad"), nd, 0)),
+        group=int(node.attrs.get("num_group", 1)))
+
+
+@converts("Deconvolution")
+def _deconv(b, node, ins, outs):
+    k = [int(x) for x in node.attrs["kernel"]]
+    nd = len(k)
+    b.add_node(
+        "ConvTranspose", ins, outs, name=node.name,
+        kernel_shape=k,
+        strides=_spatial(node.attrs.get("stride"), nd),
+        dilations=_spatial(node.attrs.get("dilate"), nd),
+        pads=_sym_pads(_spatial(node.attrs.get("pad"), nd, 0)),
+        output_padding=_spatial(node.attrs.get("adj"), nd, 0),
+        group=int(node.attrs.get("num_group", 1)))
+
+
+@converts("FullyConnected")
+def _fc(b, node, ins, outs):
+    data = ins[0]
+    if node.attrs.get("flatten", True):
+        shp = b.shape_of(data)
+        if shp is None or len(shp) != 2:
+            flat = b.unique(node.name + "_flat")
+            b.add_node("Flatten", [data], [flat], axis=1)
+            data = flat
+    no_bias = node.attrs.get("no_bias", False) or len(ins) < 3
+    gemm_in = [data, ins[1]] + ([] if no_bias else [ins[2]])
+    b.add_node("Gemm", gemm_in, outs, name=node.name,
+               alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+_ACT2ONNX = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@converts("Activation")
+def _act(b, node, ins, outs):
+    b.add_node(_ACT2ONNX[node.attrs.get("act_type", "relu")],
+               ins, outs, name=node.name)
+
+
+@converts("LeakyReLU")
+def _leaky(b, node, ins, outs):
+    at = node.attrs.get("act_type", "leaky")
+    slope = float(node.attrs.get("slope", 0.25))
+    if at in ("leaky", "rrelu"):
+        b.add_node("LeakyRelu", ins[:1], outs, name=node.name, alpha=slope)
+    elif at == "elu":
+        b.add_node("Elu", ins[:1], outs, name=node.name, alpha=slope)
+    elif at == "selu":
+        b.add_node("Selu", ins[:1], outs, name=node.name)
+    elif at == "prelu":
+        b.add_node("PRelu", ins, outs, name=node.name)
+    elif at == "gelu":
+        # exact gelu: x * 0.5 * (1 + erf(x / sqrt(2)))
+        x = ins[0]
+        d = b.unique(node.name + "_div")
+        e = b.unique(node.name + "_erf")
+        p = b.unique(node.name + "_p1")
+        h = b.unique(node.name + "_half")
+        b.add_node("Div", [x, b.const_like("sqrt2", _np.sqrt(2.0), x)], [d])
+        b.add_node("Erf", [d], [e])
+        b.add_node("Add", [e, b.const_like("one", 1.0, x)], [p])
+        b.add_node("Mul", [x, p], [h])
+        b.add_node("Mul", [h, b.const_like("half", 0.5, x)], outs,
+                   name=node.name)
+    else:
+        raise ValueError(f"LeakyReLU act_type {at!r} not exportable")
+
+
+@converts("softmax")
+def _softmax(b, node, ins, outs):
+    if node.attrs.get("temperature") not in (None, 1.0):
+        raise ValueError("softmax with temperature is not exportable")
+    b.add_node("Softmax", ins[:1], outs, name=node.name,
+               axis=int(node.attrs.get("axis", -1)))
+
+
+@converts("log_softmax")
+def _log_softmax(b, node, ins, outs):
+    b.add_node("LogSoftmax", ins[:1], outs, name=node.name,
+               axis=int(node.attrs.get("axis", -1)))
+
+
+@converts("SoftmaxOutput")
+def _softmax_output(b, node, ins, outs):
+    # inference semantics of the training head: softmax over the data input
+    b.add_node("Softmax", ins[:1], outs, name=node.name, axis=-1)
+
+
+@converts("Pooling")
+def _pooling(b, node, ins, outs):
+    pt = node.attrs.get("pool_type", "max")
+    if node.attrs.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(pt)
+        if op is None:
+            # global sum-pool: ReduceSum over spatial axes
+            shp = b.shape_of(ins[0])
+            nd = (len(shp) - 2) if shp else 2
+            b.add_node("ReduceSum",
+                       [ins[0], b.i64(node.name + "_axes",
+                                      range(2, 2 + nd))],
+                       outs, name=node.name, keepdims=1)
+            return
+        b.add_node(op, ins, outs, name=node.name)
+        return
+    k = [int(x) for x in node.attrs["kernel"]]
+    nd = len(k)
+    stride = node.attrs.get("stride")
+    kw = dict(
+        kernel_shape=k,
+        strides=k if stride is None else _spatial(stride, nd),
+        pads=_sym_pads(_spatial(node.attrs.get("pad"), nd, 0)),
+        ceil_mode=int(node.attrs.get("pooling_convention", "valid") == "full"))
+    if pt == "max":
+        b.add_node("MaxPool", ins, outs, name=node.name, **kw)
+    elif pt == "avg":
+        kw["count_include_pad"] = int(node.attrs.get("count_include_pad",
+                                                     True))
+        b.add_node("AveragePool", ins, outs, name=node.name, **kw)
+    else:
+        raise ValueError(f"pool_type {pt!r} not exportable")
+
+
+@converts("BatchNorm")
+def _batchnorm(b, node, ins, outs):
+    if int(node.attrs.get("axis", 1)) != 1:
+        raise ValueError("BatchNorm(axis != 1) not exportable — ONNX "
+                         "BatchNormalization is defined over axis 1 only")
+    b.add_node("BatchNormalization", ins, outs, name=node.name,
+               epsilon=float(node.attrs.get("eps", 1e-5)),
+               momentum=float(node.attrs.get("momentum", 0.9)))
+
+
+@converts("LayerNorm")
+def _layernorm(b, node, ins, outs):
+    if node.attrs.get("output_mean_var"):
+        raise ValueError("LayerNorm(output_mean_var=True) not exportable")
+    b.opset = max(b.opset, 17)  # LayerNormalization standardized in 17
+    b.add_node("LayerNormalization", ins, outs, name=node.name,
+               axis=int(node.attrs.get("axis", -1)),
+               epsilon=float(node.attrs.get("eps", 1e-5)))
+
+
+@converts("LRN")
+def _lrn(b, node, ins, outs):
+    b.add_node("LRN", ins, outs, name=node.name,
+               alpha=float(node.attrs.get("alpha", 1e-4)),
+               beta=float(node.attrs.get("beta", 0.75)),
+               bias=float(node.attrs.get("knorm", 2.0)),
+               size=int(node.attrs["nsize"]))
+
+
+@converts("Dropout")
+def _dropout(b, node, ins, outs):
+    # ONNX Dropout defaults to inference (identity) when training_mode
+    # is absent; ratio rides along for consumers that re-train.
+    b.add_node("Dropout", ins[:1], outs, name=node.name)
+
+
+@converts("Embedding")
+def _embedding(b, node, ins, outs):
+    idx = b.unique(node.name + "_idx")
+    b.add_node("Cast", [ins[0]], [idx], to=int(_pb.TensorProto.INT64))
+    b.add_node("Gather", [ins[1], idx], outs, name=node.name, axis=0)
+
+
+@converts("take")
+def _take(b, node, ins, outs):
+    idx = b.unique(node.name + "_idx")
+    b.add_node("Cast", [ins[1]], [idx], to=int(_pb.TensorProto.INT64))
+    b.add_node("Gather", [ins[0], idx], outs, name=node.name,
+               axis=int(node.attrs.get("axis", 0)))
+
+
+# -- elementwise binary ------------------------------------------------------
+_BINOP = {"broadcast_add": "Add", "elemwise_add": "Add", "add": "Add",
+          "broadcast_sub": "Sub", "elemwise_sub": "Sub",
+          "broadcast_mul": "Mul", "elemwise_mul": "Mul",
+          "broadcast_div": "Div", "elemwise_div": "Div",
+          "broadcast_power": "Pow",
+          "broadcast_maximum": "Max", "broadcast_minimum": "Min",
+          "maximum": "Max", "minimum": "Min"}
+
+
+def _binop(b, node, ins, outs):
+    b.add_node(_BINOP[node.op], ins, outs, name=node.name)
+
+
+for _name in _BINOP:
+    _CONVERTERS[_name] = _binop
+
+_CMPOP = {"broadcast_equal": "Equal", "broadcast_not_equal": "Equal",
+          "broadcast_greater": "Greater", "broadcast_lesser": "Less",
+          "broadcast_greater_equal": "GreaterOrEqual",
+          "broadcast_lesser_equal": "LessOrEqual"}
+
+
+def _cmpop(b, node, ins, outs):
+    raw = b.unique(node.name + "_bool")
+    b.add_node(_CMPOP[node.op], ins, [raw])
+    cur = raw
+    if node.op == "broadcast_not_equal":
+        nn = b.unique(node.name + "_not")
+        b.add_node("Not", [cur], [nn])
+        cur = nn
+    # mxtpu comparisons return 0/1 in the operand dtype, ONNX returns bool
+    dt = b.dtype_of(ins[0]) or _np.dtype(_np.float32)
+    b.add_node("Cast", [cur], outs, name=node.name,
+               to=int(np_dtype_to_onnx(dt)))
+
+
+for _name in _CMPOP:
+    _CONVERTERS[_name] = _cmpop
+
+# -- scalar ops --------------------------------------------------------------
+_SCALAR = {"_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+           "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+           "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+           "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
+           "_maximum_scalar": ("Max", False), "_minimum_scalar": ("Min", False),
+           "_mod_scalar": ("Mod", False)}
+
+
+def _scalar_op(b, node, ins, outs):
+    op, rev = _SCALAR[node.op]
+    sc = node.attrs.get("scalar", 0.0)
+    # the scalar const takes the NODE OUTPUT dtype (what jnp's promotion
+    # produced natively — e.g. int32 / 2 → float32); when that differs
+    # from the input dtype, cast the input so the ONNX binary op sees
+    # matching operand types and reproduces the native numerics
+    out_dt = b.dtype_of(node.name)
+    in_dt = b.dtype_of(ins[0])
+    x = ins[0]
+    if out_dt is not None and in_dt is not None and out_dt != in_dt:
+        cast_in = b.unique(node.name + "_castin")
+        b.add_node("Cast", [x], [cast_in], to=int(np_dtype_to_onnx(out_dt)))
+        x = cast_in
+    dt = out_dt or in_dt or _np.dtype(_np.float32)
+    c = b.add_initializer(node.name + "_scalar", _np.asarray(sc, dtype=dt))
+    lhs, rhs = (c, x) if rev else (x, c)
+    if op == "Mod" and dt.kind == "f":
+        # jnp.mod is floor-mod; ONNX float Mod must be fmod=1 (C fmod),
+        # which differs on negatives — decompose: a - floor(a/b)*b
+        d = b.unique(node.name + "_div")
+        fl = b.unique(node.name + "_floor")
+        mu = b.unique(node.name + "_mul")
+        b.add_node("Div", [lhs, rhs], [d])
+        b.add_node("Floor", [d], [fl])
+        b.add_node("Mul", [fl, rhs], [mu])
+        b.add_node("Sub", [lhs, mu], outs, name=node.name)
+        return
+    b.add_node(op, [lhs, rhs], outs, name=node.name)
+
+
+for _name in _SCALAR:
+    _CONVERTERS[_name] = _scalar_op
+
+# -- unary -------------------------------------------------------------------
+_UNARY = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "negative": "Neg",
+          "abs": "Abs", "erf": "Erf", "floor": "Floor", "ceil": "Ceil",
+          "round": "Round", "sign": "Sign", "sin": "Sin", "cos": "Cos",
+          "identity": "Identity", "BlockGrad": "Identity",
+          "stop_gradient": "Identity", "reciprocal": "Reciprocal"}
+
+
+def _unary(b, node, ins, outs):
+    b.add_node(_UNARY[node.op], ins[:1], outs, name=node.name)
+
+
+for _name in _UNARY:
+    _CONVERTERS[_name] = _unary
+
+
+@converts("square")
+def _square(b, node, ins, outs):
+    b.add_node("Mul", [ins[0], ins[0]], outs, name=node.name)
+
+
+@converts("rsqrt")
+def _rsqrt(b, node, ins, outs):
+    s = b.unique(node.name + "_sqrt")
+    b.add_node("Sqrt", ins[:1], [s])
+    b.add_node("Reciprocal", [s], outs, name=node.name)
+
+
+# -- shape ops ---------------------------------------------------------------
+@converts("reshape")
+def _reshape(b, node, ins, outs):
+    if node.attrs.get("reverse"):
+        raise ValueError("reshape(reverse=True) not exportable")
+    shape = [int(x) for x in node.attrs["shape"]]
+    if any(s in (-2, -3, -4) for s in shape):
+        # resolve MXNet special codes against the inferred output shape
+        shp = b.shape_of(node.name)
+        if shp is None:
+            raise ValueError(f"reshape special codes need inferred shapes "
+                             f"({node.name})")
+        shape = [int(x) for x in shp]
+    b.add_node("Reshape", [ins[0], b.i64(node.name + "_shape", shape)],
+               outs, name=node.name)
+
+
+@converts("Flatten", "flatten")
+def _flatten(b, node, ins, outs):
+    b.add_node("Flatten", ins, outs, name=node.name, axis=1)
+
+
+@converts("transpose")
+def _transpose(b, node, ins, outs):
+    axes = node.attrs.get("axes")
+    kw = {"perm": [int(a) for a in axes]} if axes else {}
+    b.add_node("Transpose", ins, outs, name=node.name, **kw)
+
+
+@converts("swapaxes")
+def _swapaxes(b, node, ins, outs):
+    shp = b.shape_of(ins[0])
+    if shp is None:
+        raise ValueError("swapaxes export needs inferred input shape")
+    perm = list(range(len(shp)))
+    d1, d2 = int(node.attrs.get("dim1", 0)), int(node.attrs.get("dim2", 0))
+    perm[d1], perm[d2] = perm[d2], perm[d1]
+    b.add_node("Transpose", ins, outs, name=node.name, perm=perm)
+
+
+@converts("expand_dims")
+def _expand_dims(b, node, ins, outs):
+    b.add_node("Unsqueeze",
+               [ins[0], b.i64(node.name + "_axes",
+                              [int(node.attrs["axis"])])],
+               outs, name=node.name)
+
+
+@converts("squeeze")
+def _squeeze(b, node, ins, outs):
+    ax = node.attrs.get("axis")
+    inputs = [ins[0]]
+    if ax is not None:
+        axes = [ax] if isinstance(ax, int) else list(ax)
+        inputs.append(b.i64(node.name + "_axes", [int(a) for a in axes]))
+    b.add_node("Squeeze", inputs, outs, name=node.name)
+
+
+@converts("concat")
+def _concat(b, node, ins, outs):
+    b.add_node("Concat", ins, outs, name=node.name,
+               axis=int(node.attrs.get("dim", 1)))
+
+
+@converts("stack")
+def _stack(b, node, ins, outs):
+    axis = int(node.attrs.get("axis", 0))
+    axes = b.i64(node.name + "_axes", [axis])
+    unsq = []
+    for i, x in enumerate(ins):
+        u = b.unique(f"{node.name}_u{i}")
+        b.add_node("Unsqueeze", [x, axes], [u])
+        unsq.append(u)
+    b.add_node("Concat", unsq, outs, name=node.name, axis=axis)
+
+
+@converts("split")
+def _split(b, node, ins, outs):
+    axis = int(node.attrs.get("axis", 1))
+    if node.attrs.get("squeeze_axis"):
+        raw = [b.unique(f"{node.name}_p{i}") for i in range(len(outs))]
+        b.add_node("Split", ins, raw, name=node.name, axis=axis)
+        axes = b.i64(node.name + "_axes", [axis])
+        for r, o in zip(raw, outs):
+            b.add_node("Squeeze", [r, axes], [o])
+    else:
+        b.add_node("Split", ins, outs, name=node.name, axis=axis)
+
+
+@converts("slice")
+def _slice(b, node, ins, outs):
+    begin = [int(x) for x in node.attrs["begin"]]
+    end = [2 ** 62 if e is None else int(e) for e in node.attrs["end"]]
+    step = node.attrs.get("step")
+    inputs = [ins[0],
+              b.i64(node.name + "_starts", begin),
+              b.i64(node.name + "_ends", end),
+              b.i64(node.name + "_axes", range(len(begin)))]
+    if step:
+        inputs.append(b.i64(node.name + "_steps",
+                            [1 if s is None else int(s) for s in step]))
+    b.add_node("Slice", inputs, outs, name=node.name)
+
+
+@converts("slice_axis")
+def _slice_axis(b, node, ins, outs):
+    axis = int(node.attrs["axis"])
+    begin = int(node.attrs["begin"])
+    end = node.attrs.get("end")
+    b.add_node("Slice",
+               [ins[0],
+                b.i64(node.name + "_starts", [begin]),
+                b.i64(node.name + "_ends",
+                      [2 ** 62 if end is None else int(end)]),
+                b.i64(node.name + "_axes", [axis])],
+               outs, name=node.name)
+
+
+@converts("clip")
+def _clip(b, node, ins, outs):
+    # ONNX Clip takes optional min/max inputs; an absent bound is an
+    # empty-string placeholder, NOT a materialized ±inf (which would
+    # overflow integer dtypes)
+    inputs = [ins[0]]
+    a_min, a_max = node.attrs.get("a_min"), node.attrs.get("a_max")
+    inputs.append("" if a_min is None
+                  else b.const_like(node.name + "_min", a_min, ins[0]))
+    if a_max is not None:
+        inputs.append(b.const_like(node.name + "_max", a_max, ins[0]))
+    elif inputs[1] == "":
+        inputs = inputs[:1]  # no bounds at all
+    b.add_node("Clip", inputs, outs, name=node.name)
+
+
+@converts("cast")
+def _cast(b, node, ins, outs):
+    b.add_node("Cast", ins, outs, name=node.name,
+               to=int(np_dtype_to_onnx(node.attrs["dtype"])))
+
+
+@converts("pad")
+def _pad(b, node, ins, outs):
+    pw = [int(x) for x in node.attrs["pad_width"]]
+    nd = len(pw) // 2
+    onnx_pads = [pw[2 * i] for i in range(nd)] + \
+                [pw[2 * i + 1] for i in range(nd)]
+    mode = node.attrs.get("mode", "constant")
+    inputs = [ins[0], b.i64(node.name + "_pads", onnx_pads)]
+    if mode == "constant":
+        inputs.append(b.const_like(node.name + "_cval",
+                                   node.attrs.get("constant_value", 0),
+                                   ins[0]))
+    b.add_node("Pad", inputs, outs, name=node.name,
+               mode={"constant": "constant", "edge": "edge",
+                     "reflect": "reflect"}[mode])
+
+
+@converts("where")
+def _where(b, node, ins, outs):
+    cond = b.unique(node.name + "_cond")
+    b.add_node("Cast", [ins[0]], [cond], to=int(_pb.TensorProto.BOOL))
+    b.add_node("Where", [cond, ins[1], ins[2]], outs, name=node.name)
+
+
+@converts("add_n")
+def _add_n(b, node, ins, outs):
+    b.add_node("Sum", ins, outs, name=node.name)
+
+
+# -- reductions --------------------------------------------------------------
+_REDUCE = {"mean": "ReduceMean", "max": "ReduceMax", "min": "ReduceMin",
+           "prod": "ReduceProd"}
+
+
+def _reduce(b, node, ins, outs):
+    ax = node.attrs.get("axis")
+    kw = {"keepdims": int(bool(node.attrs.get("keepdims", False)))}
+    if ax is not None:
+        kw["axes"] = [ax] if isinstance(ax, int) else [int(a) for a in ax]
+    b.add_node(_REDUCE[node.op], ins[:1], outs, name=node.name, **kw)
+
+
+for _name in _REDUCE:
+    _CONVERTERS[_name] = _reduce
+
+
+@converts("sum")
+def _reduce_sum(b, node, ins, outs):
+    # opset 13 moved ReduceSum's axes from attr to input
+    ax = node.attrs.get("axis")
+    inputs = [ins[0]]
+    if ax is not None:
+        axes = [ax] if isinstance(ax, int) else list(ax)
+        inputs.append(b.i64(node.name + "_axes", [int(a) for a in axes]))
+    b.add_node("ReduceSum", inputs, outs, name=node.name,
+               keepdims=int(bool(node.attrs.get("keepdims", False))))
+
+
+@converts("dot")
+def _dot(b, node, ins, outs):
+    a, c = ins
+    sa, sc = b.shape_of(a), b.shape_of(c)
+    if node.attrs.get("transpose_a"):
+        if sa is None or len(sa) != 2:
+            raise ValueError("dot(transpose_a) export needs 2-D lhs")
+        t = b.unique(node.name + "_at")
+        b.add_node("Transpose", [a], [t], perm=[1, 0])
+        a = t
+    if node.attrs.get("transpose_b"):
+        if sc is None or len(sc) != 2:
+            raise ValueError("dot(transpose_b) export needs 2-D rhs")
+        t = b.unique(node.name + "_bt")
+        b.add_node("Transpose", [c], [t], perm=[1, 0])
+        c = t
+    # MXNet dot contracts lhs-last with rhs-first: MatMul agrees when the
+    # rhs is ≤2-D (the overwhelmingly common case)
+    if sc is not None and len(sc) > 2:
+        raise ValueError("dot with >2-D rhs is not exportable to MatMul")
+    b.add_node("MatMul", [a, c], outs, name=node.name)
+
+
+@converts("batch_dot")
+def _batch_dot(b, node, ins, outs):
+    a, c = ins
+    for key, idx in (("transpose_a", 0), ("transpose_b", 1)):
+        if node.attrs.get(key):
+            shp = b.shape_of(ins[idx])
+            if shp is None:
+                raise ValueError(f"batch_dot({key}) export needs shapes")
+            perm = list(range(len(shp)))
+            perm[-1], perm[-2] = perm[-2], perm[-1]
+            t = b.unique(f"{node.name}_t{idx}")
+            b.add_node("Transpose", [ins[idx]], [t], perm=perm)
+            if idx == 0:
+                a = t
+            else:
+                c = t
+    b.add_node("MatMul", [a, c], outs, name=node.name)
+
+
+# -- graph-level export ------------------------------------------------------
+def _onnx_value_names(node, index_of) -> List[str]:
+    n_out = node.num_outputs or 1
+    return [node.name if i == 0 else f"{node.name}_out{i}"
+            for i in range(n_out)]
+
+
+def export_graph(sym, params: Dict[str, Any],
+                 input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 opset: int = 13,
+                 graph_name: str = "mxtpu") -> _pb.ModelProto:
+    """Symbol + params → ModelProto. `params` maps var name → NDArray or
+    numpy array (becomes an initializer); remaining vars are graph inputs
+    whose shapes come from `input_shapes`."""
+    import jax
+
+    np_params = {}
+    for k, v in params.items():
+        np_params[k] = _np.asarray(getattr(v, "asnumpy", lambda: v)())
+
+    nodes = sym._topo()
+    b = GraphBuilder(opset=opset)
+
+    # shape/dtype inference over the whole graph (jax.eval_shape — abstract)
+    kw = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+          for k, v in np_params.items()}
+    for k, v in (input_shapes or {}).items():
+        kw.setdefault(k, jax.ShapeDtypeStruct(tuple(v), _np.float32))
+    structs = sym._infer_structs(**kw)
+    entry_structs = {}
+    if structs is not None:
+        entry_structs, var_structs = structs
+
+    value_names: Dict[Tuple[int, int], str] = {}
+    for node in nodes:
+        if node.is_var():
+            value_names[(id(node), 0)] = node.name
+            b._names_used.add(node.name)
+            if node.name in np_params:
+                arr = np_params[node.name]
+                b.initializers.append(make_tensor(node.name, arr))
+                b._struct_of[node.name] = jax.ShapeDtypeStruct(
+                    arr.shape, arr.dtype)
+            else:
+                if structs is not None and node.name in var_structs:
+                    st = var_structs[node.name]
+                elif input_shapes and node.name in input_shapes:
+                    st = jax.ShapeDtypeStruct(
+                        tuple(input_shapes[node.name]), _np.float32)
+                else:
+                    raise ValueError(
+                        f"input {node.name!r}: no shape available — pass "
+                        f"input_shapes={{'{node.name}': (...)}}")
+                b.inputs.append(b.value_info(node.name, st))
+                b._struct_of[node.name] = st
+
+    # fix_gamma: reference BatchNorm semantic — gamma is pinned to 1
+    for node in nodes:
+        if node.op == "BatchNorm" and node.attrs.get("fix_gamma", False):
+            gnode, gidx = node.inputs[1]
+            gname = gnode.name
+            for t in b.initializers:
+                if t.name == gname:
+                    arr = _np.ones_like(tensor_to_np(t))
+                    t.CopyFrom(make_tensor(gname, arr))
+
+    for node in nodes:
+        if node.is_var():
+            continue
+        outs = _onnx_value_names(node, None)
+        for i, o in enumerate(outs):
+            value_names[(id(node), i)] = o
+            st = entry_structs.get((id(node), i))
+            if st is not None:
+                b._struct_of[o] = st
+        ins = [value_names[(id(p), i)] for p, i in node.inputs]
+        conv = _CONVERTERS.get(node.op)
+        if conv is None:
+            raise ValueError(
+                f"op {node.op!r} ({node.name}) has no ONNX converter; "
+                f"supported: {sorted(_CONVERTERS)}")
+        conv(b, node, ins, outs)
+
+    model = _pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxtpu"
+    model.producer_version = "1.0"
+    model.opset_import.add(domain="", version=b.opset)
+    g = model.graph
+    g.name = graph_name
+    g.node.extend(b.nodes)
+    g.initializer.extend(b.initializers)
+    g.input.extend(b.inputs)
+    for head, i in sym._entries:
+        name = value_names[(id(head), i)]
+        st = b._struct_of.get(name)
+        if st is not None:
+            g.output.append(b.value_info(name, st))
+        else:
+            vi = _pb.ValueInfoProto()
+            vi.name = name
+            g.output.append(vi)
+    return model

@@ -715,6 +715,7 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
     """Transposed convolution (reference src/operator/nn/deconvolution.cc)."""
     nd = len(kernel)
     stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
     pad_ = tuple(pad) if pad else (0,) * nd
     adj = tuple(adj) if adj else (0,) * nd
     arrs = [data, weight] + ([] if no_bias or bias is None else [bias])
@@ -722,12 +723,24 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
             3: ("NCDHW", "IODHW", "NCDHW")}[nd]
 
     def _f(x, w, *b):
-        pads = [(k - 1 - p, k - 1 - p + a)
-                for k, p, a in zip(kernel, pad_, adj)]
+        if num_group > 1:
+            # grouped transposed conv: lax's feature_group_count expects
+            # rhs input-feature dim = C_in/g with ALL outputs along the
+            # O dim, but the (I, O/g, ...) deconv weight groups along I —
+            # regroup to (I/g, O, ...) with group-j's block in output
+            # columns j*O/g:(j+1)*O/g
+            gi = w.shape[0] // num_group
+            w = w.reshape((num_group, gi) + w.shape[1:])
+            w = jnp.moveaxis(w, 0, 1)
+            w = w.reshape((gi, num_group * w.shape[2]) + w.shape[3:])
+        # padding is computed from the EFFECTIVE (dilated) kernel extent
+        pads = [((k - 1) * d + 1 - 1 - p, (k - 1) * d + 1 - 1 - p + a)
+                for k, d, p, a in zip(kernel, dilate, pad_, adj)]
         y = lax.conv_general_dilated(
             x, jnp.flip(w, axis=tuple(range(2, 2 + nd))),
             window_strides=(1,) * nd, padding=pads,
-            lhs_dilation=stride, dimension_numbers=spec,
+            lhs_dilation=stride, rhs_dilation=dilate,
+            dimension_numbers=spec,
             feature_group_count=num_group)
         if b:
             y = y + b[0].reshape((1, -1) + (1,) * nd)

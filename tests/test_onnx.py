@@ -1,0 +1,483 @@
+"""ONNX interchange tests.
+
+Validation story (mxtpu/contrib/onnx/README.md): no onnx package or
+onnxruntime exists in this environment, so correctness rests on
+(a) numerical round-trips — export → import → same outputs — and
+(b) an independent wire-level walk of the serialized bytes with a
+hand-written protobuf reader asserting the ONNX spec's field layout
+(field numbers spelled here from the public spec, NOT read from our
+schema file — a transcription error in onnx.proto would diverge).
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.ndarray as nd
+import mxtpu.symbol as sym
+from mxtpu.contrib import onnx as onnx_mxtpu
+
+
+def _eval_symbol(s, args, auxs=None):
+    ex = s.bind(mx.cpu(), args, aux_states=auxs or {})
+    outs = ex.forward(is_train=False)
+    return [o.asnumpy() for o in outs]
+
+
+def _roundtrip(s, params, input_arrays, tmp_path, atol=1e-5):
+    """Export symbol+params, re-import, run both, compare outputs."""
+    path = str(tmp_path / "model.onnx")
+    onnx_mxtpu.export_model(
+        s, params, input_shapes={k: v.shape for k, v in input_arrays.items()},
+        onnx_file=path)
+    sym2, arg2, aux2 = onnx_mxtpu.import_model(path)
+
+    args1 = dict(params)
+    args1.update({k: nd.array(v) for k, v in input_arrays.items()})
+    ref = _eval_symbol(s, {k: v for k, v in args1.items()
+                           if k in s.list_arguments()},
+                       {k: v for k, v in args1.items()
+                        if k in s.list_auxiliary_states()})
+
+    args2 = dict(arg2)
+    args2.update({k: nd.array(v) for k, v in input_arrays.items()})
+    got = _eval_symbol(sym2, {k: v for k, v in args2.items()
+                              if k in sym2.list_arguments()}, aux2)
+    assert len(ref) == len(got)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(r, g, atol=atol, rtol=1e-5)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# round-trips
+# ---------------------------------------------------------------------------
+def test_mlp_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    data = sym.var("data")
+    w1, b1 = sym.var("w1"), sym.var("b1")
+    w2, b2 = sym.var("w2"), sym.var("b2")
+    h = sym.Activation(sym.FullyConnected(data, w1, b1, num_hidden=16),
+                       act_type="relu")
+    out = sym.softmax(sym.FullyConnected(h, w2, b2, num_hidden=4), axis=-1)
+    params = {"w1": nd.array(rng.randn(16, 8).astype(np.float32)),
+              "b1": nd.array(rng.randn(16).astype(np.float32)),
+              "w2": nd.array(rng.randn(4, 16).astype(np.float32)),
+              "b2": nd.array(rng.randn(4).astype(np.float32))}
+    x = rng.randn(2, 8).astype(np.float32)
+    _roundtrip(out, params, {"data": x}, tmp_path)
+
+
+def test_convnet_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    data = sym.var("data")
+    w = sym.var("cw")
+    cb = sym.var("cb")
+    gamma, beta = sym.var("gamma"), sym.var("beta")
+    mmean, mvar = sym.var("mmean"), sym.var("mvar")
+    c = sym.Convolution(data, w, cb, num_filter=6, kernel=(3, 3),
+                        stride=(1, 1), pad=(1, 1))
+    bn = sym.BatchNorm(c, gamma, beta, mmean, mvar, eps=1e-5,
+                       use_global_stats=True)
+    a = sym.Activation(bn, act_type="relu")
+    p = sym.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    g = sym.Pooling(p, global_pool=True, pool_type="avg")
+    out = sym.Flatten(g)
+    params = {"cw": nd.array(rng.randn(6, 3, 3, 3).astype(np.float32) * 0.1),
+              "cb": nd.array(rng.randn(6).astype(np.float32)),
+              "gamma": nd.array(rng.rand(6).astype(np.float32) + 0.5),
+              "beta": nd.array(rng.randn(6).astype(np.float32)),
+              "mmean": nd.array(rng.randn(6).astype(np.float32) * 0.1),
+              "mvar": nd.array(rng.rand(6).astype(np.float32) + 0.5)}
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    path = _roundtrip(out, params, {"data": x}, tmp_path)
+
+    # running stats must land in aux on import, like the reference
+    _, _, aux = onnx_mxtpu.import_model(path)
+    assert set(aux) == {"mmean", "mvar"}
+
+
+def test_shape_and_scalar_ops_roundtrip(tmp_path):
+    rng = np.random.RandomState(2)
+    data = sym.var("data")
+    y = (data * 2.0 + 1.5) / 0.5
+    y = sym.transpose(y, axes=(0, 2, 1))
+    y = sym.reshape(y, shape=(0, -1))
+    y = sym.clip(y, a_min=-2.0, a_max=2.0)
+    y = sym.expand_dims(y, axis=1)
+    y = sym.squeeze(y, axis=1)
+    y = sym.concat(y, y, dim=1)
+    y = sym.slice_axis(y, axis=1, begin=0, end=6)
+    y = sym.mean(y, axis=1, keepdims=True)
+    out = sym.cast(y, dtype="float32")
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    _roundtrip(out, {}, {"data": x}, tmp_path)
+
+
+def test_binary_reduce_matmul_roundtrip(tmp_path):
+    rng = np.random.RandomState(3)
+    a, b = sym.var("a"), sym.var("b")
+    w = sym.var("w")
+    y = sym.broadcast_add(a, b) * sym.broadcast_maximum(a, b)
+    y = sym.dot(y, w)
+    y = sym.sum(y, axis=-1, keepdims=False)
+    out = sym.exp(sym.negative(sym.sqrt(sym.abs(y))))
+    params = {"w": nd.array(rng.randn(4, 5).astype(np.float32))}
+    arrays = {"a": rng.randn(2, 4).astype(np.float32),
+              "b": rng.rand(1, 4).astype(np.float32)}
+    _roundtrip(out, params, arrays, tmp_path)
+
+
+def test_embedding_gather_roundtrip(tmp_path):
+    rng = np.random.RandomState(4)
+    idx = sym.var("idx")
+    table = sym.var("table")
+    out = sym.Embedding(idx, table, input_dim=10, output_dim=6)
+    params = {"table": nd.array(rng.randn(10, 6).astype(np.float32))}
+    # float indices, the MXNet convention the Cast-to-int64 export handles
+    arrays = {"idx": np.array([[0, 3], [9, 5]], dtype=np.float32)}
+    _roundtrip(out, params, arrays, tmp_path)
+
+
+def test_gluon_model_zoo_roundtrip(tmp_path):
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.mobilenet_v2_0_25(pretrained=False)
+    net.initialize()
+    x = nd.array(np.random.RandomState(5).rand(1, 3, 64, 64)
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+
+    path = str(tmp_path / "m.onnx")
+    onnx_mxtpu.export_model(net, input_shapes=[(1, 3, 64, 64)],
+                            onnx_file=path)
+    block = onnx_mxtpu.import_to_gluon(path)
+    got = block(x).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=1e-4, rtol=1e-4)
+
+
+def test_get_model_metadata(tmp_path):
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.FullyConnected(data, w, no_bias=True, num_hidden=3,
+                             flatten=False)
+    params = {"w": nd.array(np.zeros((3, 7), np.float32))}
+    path = str(tmp_path / "meta.onnx")
+    onnx_mxtpu.export_model(out, params, input_shapes={"data": (2, 7)},
+                            onnx_file=path)
+    meta = onnx_mxtpu.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 7))]
+    (oname, oshape), = meta["output_tensor_data"]
+    assert oshape == (2, 3)
+
+
+def test_unsupported_op_raises(tmp_path):
+    data = sym.var("data")
+    out = sym.topk(data, k=2)  # no ONNX converter registered
+    with pytest.raises(ValueError, match="topk"):
+        onnx_mxtpu.export_model(out, {}, input_shapes={"data": (2, 5)},
+                                onnx_file=str(tmp_path / "x.onnx"))
+
+
+# ---------------------------------------------------------------------------
+# wire-format check, independent of google.protobuf
+# ---------------------------------------------------------------------------
+def _read_varint(buf, pos):
+    val = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+
+
+def _walk_fields(buf):
+    """Yield (field_number, wire_type, payload) over a protobuf message."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _read_varint(buf, pos)
+            yield fno, wt, v
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            yield fno, wt, buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            yield fno, wt, buf[pos:pos + 4]
+            pos += 4
+        elif wt == 1:
+            yield fno, wt, buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise AssertionError(f"unexpected wire type {wt}")
+
+
+def test_wire_format_matches_onnx_spec(tmp_path):
+    """Walk the serialized ModelProto with a from-scratch protobuf reader
+    and assert the ONNX spec's field numbers: ModelProto.ir_version=1,
+    .graph=7, .opset_import=8; GraphProto.node=1, .initializer=5,
+    .input=11, .output=12; NodeProto.input=1, .output=2, .op_type=4;
+    TensorProto.dims=1, .data_type=2, .name=8, .raw_data=9."""
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.Activation(
+        sym.FullyConnected(data, w, no_bias=True, num_hidden=3,
+                           flatten=False), act_type="relu")
+    params = {"w": nd.array(np.arange(21, dtype=np.float32).reshape(3, 7))}
+    path = str(tmp_path / "wire.onnx")
+    onnx_mxtpu.export_model(out, params, input_shapes={"data": (2, 7)},
+                            onnx_file=path)
+    buf = open(path, "rb").read()
+
+    model = {f: v for f, _, v in _walk_fields(buf) if f in (1, 7)}
+    assert model[1] == 8  # ir_version 8 as a field-1 varint
+    graph = model[7]
+
+    nodes, inits, g_inputs, g_outputs = [], [], [], []
+    for f, _, v in _walk_fields(graph):
+        if f == 1:
+            nodes.append(v)
+        elif f == 5:
+            inits.append(v)
+        elif f == 11:
+            g_inputs.append(v)
+        elif f == 12:
+            g_outputs.append(v)
+    assert len(nodes) == 2 and len(inits) == 1
+    assert len(g_inputs) == 1 and len(g_outputs) == 1
+
+    op_types = []
+    for nbuf in nodes:
+        fields = list(_walk_fields(nbuf))
+        op_types.append(next(v for f, _, v in fields if f == 4).decode())
+        assert any(f == 1 for f, _, v in fields)  # inputs present
+        assert any(f == 2 for f, _, v in fields)  # outputs present
+    assert op_types == ["Gemm", "Relu"]
+
+    tfields = list(_walk_fields(inits[0]))
+    name = next(v for f, _, v in tfields if f == 8).decode()
+    assert name == "w"
+    dtype = next(v for f, wt, v in tfields if f == 2 and wt == 0)
+    assert dtype == 1  # TensorProto.FLOAT
+    raw = next(v for f, _, v in tfields if f == 9)
+    np.testing.assert_array_equal(
+        np.frombuffer(raw, np.float32).reshape(3, 7),
+        np.arange(21, dtype=np.float32).reshape(3, 7))
+    # dims may arrive packed (wire type 2) or unpacked (wire type 0)
+    dims = []
+    for f, wt, v in tfields:
+        if f == 1:
+            if wt == 0:
+                dims.append(v)
+            else:
+                p = 0
+                while p < len(v):
+                    d, p = _read_varint(v, p)
+                    dims.append(d)
+    assert dims == [3, 7]
+
+
+# ---------------------------------------------------------------------------
+# external-producer paths: protos built by hand, the way other tools emit
+# them (typed data fields, axes/sizes as inputs) — not our exporter's output
+# ---------------------------------------------------------------------------
+def _base_model():
+    pb = onnx_mxtpu.onnx_pb2
+    m = pb.ModelProto(ir_version=8, producer_name="external")
+    m.opset_import.add(domain="", version=13)
+    return pb, m
+
+
+def _add_input(m, name, shape, elem_type=1):
+    vi = m.graph.input.add()
+    vi.name = name
+    tt = vi.type.tensor_type
+    tt.elem_type = elem_type
+    for d in shape:
+        tt.shape.dim.add().dim_value = d
+
+
+def _load(m, tmp_path, fname="ext.onnx"):
+    path = str(tmp_path / fname)
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    return path
+
+
+def test_import_fp16_typed_int32_data(tmp_path):
+    """fp16 initializers in int32_data carry BIT PATTERNS per the spec
+    (what onnx.helper.make_tensor emits without raw=True)."""
+    pb, m = _base_model()
+    _add_input(m, "x", (2, 3), elem_type=pb.TensorProto.FLOAT16)
+    w = m.graph.initializer.add(name="w", data_type=pb.TensorProto.FLOAT16,
+                                dims=[2, 3])
+    vals = np.array([1.0, -2.5, 0.0, 65504.0, 0.5, -1.0], np.float16)
+    w.int32_data.extend(int(v) for v in vals.view(np.uint16))
+    m.graph.node.add(op_type="Add", input=["x", "w"], output=["y"],
+                     name="add0")
+    vo = m.graph.output.add()
+    vo.name = "y"
+    _, arg_params, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    np.testing.assert_array_equal(arg_params["w"].asnumpy(),
+                                  vals.reshape(2, 3))
+
+
+def test_import_split_sizes_input(tmp_path):
+    """opset 13 Split carries sizes as input[1]: equal sizes import,
+    unequal sizes must raise rather than silently splitting equally."""
+    pb, m = _base_model()
+    _add_input(m, "x", (2, 8))
+    sz = m.graph.initializer.add(name="sz", data_type=pb.TensorProto.INT64,
+                                 dims=[2])
+    sz.int64_data.extend([4, 4])
+    n = m.graph.node.add(op_type="Split", input=["x", "sz"],
+                         output=["a", "b"], name="split0")
+    ax = n.attribute.add()
+    ax.name = "axis"
+    ax.type = pb.AttributeProto.INT
+    ax.i = 1
+    for o in ("a", "b"):
+        m.graph.output.add().name = o
+    sym2, _, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    x = np.arange(16, dtype=np.float32).reshape(2, 8)
+    outs = _eval_symbol(sym2, {"x": nd.array(x)})
+    assert outs[0].shape == (2, 4) and outs[1].shape == (2, 4)
+    np.testing.assert_array_equal(np.concatenate(outs, axis=1), x)
+
+    sz.ClearField("int64_data")
+    sz.int64_data.extend([3, 5])
+    with pytest.raises(ValueError, match="unequal Split"):
+        onnx_mxtpu.import_model(_load(m, tmp_path, "uneq.onnx"))
+
+
+def test_import_reduce_empty_axes_is_reduce_all(tmp_path):
+    pb, m = _base_model()
+    _add_input(m, "x", (2, 3))
+    ax = m.graph.initializer.add(name="ax", data_type=pb.TensorProto.INT64,
+                                 dims=[0])
+    m.graph.node.add(op_type="ReduceSum", input=["x", "ax"], output=["y"],
+                     name="rs0")
+    m.graph.output.add().name = "y"
+    sym2, _, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out, = _eval_symbol(sym2, {"x": nd.array(x)})
+    np.testing.assert_allclose(out.reshape(()), x.sum())
+
+
+def test_import_clip_runtime_bound_raises(tmp_path):
+    """Clip bounds computed by another node (not constants) must raise,
+    not silently drop the bound."""
+    pb, m = _base_model()
+    _add_input(m, "x", (2, 3))
+    _add_input(m, "lo", (1,))
+    m.graph.node.add(op_type="Clip", input=["x", "lo"], output=["y"],
+                     name="clip0")
+    m.graph.output.add().name = "y"
+    with pytest.raises(ValueError, match="Clip bound"):
+        onnx_mxtpu.import_model(_load(m, tmp_path))
+
+
+def test_export_batchnorm_axis_raises(tmp_path):
+    data = sym.var("data")
+    g, b_, mm, mv = (sym.var(n) for n in ("g", "b", "mm", "mv"))
+    out = sym.BatchNorm(data, g, b_, mm, mv, axis=-1)
+    params = {n: nd.array(np.ones(4, np.float32)) for n in
+              ("g", "b", "mm", "mv")}
+    with pytest.raises(ValueError, match="axis"):
+        onnx_mxtpu.export_model(out, params,
+                                input_shapes={"data": (2, 3, 4)},
+                                onnx_file=str(tmp_path / "bn.onnx"))
+
+
+def test_scalar_op_on_int_input_roundtrip(tmp_path):
+    """int32 / 2 promotes to float32 natively (jnp semantics); the export
+    must cast + use a float const, not truncate the scalar to int."""
+    data = sym.var("data")
+    out = sym.cast(data, dtype="int32") / 2.0 + 0.25
+    x = np.array([[5.0, 7.0, 9.0]], np.float32)
+    _roundtrip(out, {}, {"data": x}, tmp_path)
+
+
+def test_clip_min_none_on_int_roundtrip(tmp_path):
+    data = sym.var("data")
+    out = sym.clip(sym.cast(data, dtype="int32"), a_min=None, a_max=5.0)
+    x = np.array([[1.0, 9.0, -3.0]], np.float32)
+    _roundtrip(out, {}, {"data": x}, tmp_path)
+
+
+def test_deconvolution_dilated_roundtrip(tmp_path):
+    rng = np.random.RandomState(7)
+    data = sym.var("data")
+    w = sym.var("dw")
+    out = sym.Deconvolution(data, w, kernel=(3, 3), stride=(2, 2),
+                            pad=(1, 1), adj=(1, 1), dilate=(2, 2),
+                            num_filter=4, no_bias=True)
+    params = {"dw": nd.array(rng.randn(3, 4, 3, 3).astype(np.float32) * 0.2)}
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    _roundtrip(out, params, {"data": x}, tmp_path, atol=1e-4)
+
+
+def test_import_auto_pad_raises(tmp_path):
+    pb, m = _base_model()
+    _add_input(m, "x", (1, 1, 4, 4))
+    w = m.graph.initializer.add(name="w", data_type=pb.TensorProto.FLOAT,
+                                dims=[1, 1, 3, 3])
+    w.raw_data = np.ones((1, 1, 3, 3), np.float32).tobytes()
+    n = m.graph.node.add(op_type="Conv", input=["x", "w"], output=["y"],
+                         name="conv0")
+    ap = n.attribute.add()
+    ap.name = "auto_pad"
+    ap.type = pb.AttributeProto.STRING
+    ap.s = b"SAME_UPPER"
+    m.graph.output.add().name = "y"
+    with pytest.raises(ValueError, match="auto_pad"):
+        onnx_mxtpu.import_model(_load(m, tmp_path))
+
+
+def test_float_mod_roundtrip_negative_values(tmp_path):
+    """float % exports as the floor-mod decomposition (ONNX float Mod is
+    C-fmod, which differs on negatives)."""
+    data = sym.var("data")
+    out = data % 2.5
+    x = np.array([[-7.0, -1.0, 1.0, 7.0]], np.float32)
+    _roundtrip(out, {}, {"data": x}, tmp_path)
+
+
+def test_import_fmod_c_semantics(tmp_path):
+    pb, m = _base_model()
+    _add_input(m, "x", (1, 3))
+    w = m.graph.initializer.add(name="w", data_type=pb.TensorProto.FLOAT,
+                                dims=[1, 3])
+    w.raw_data = np.array([[3.0, 3.0, 3.0]], np.float32).tobytes()
+    n = m.graph.node.add(op_type="Mod", input=["x", "w"], output=["y"],
+                         name="mod0")
+    a = n.attribute.add()
+    a.name = "fmod"
+    a.type = pb.AttributeProto.INT
+    a.i = 1
+    m.graph.output.add().name = "y"
+    sym2, args, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    x = np.array([[-7.0, -1.0, 7.0]], np.float32)
+    binds = {k: v for k, v in args.items()}
+    binds["x"] = nd.array(x)
+    out, = _eval_symbol(sym2, binds)
+    # C fmod keeps the dividend's sign: -7 fmod 3 = -1 (not 2)
+    np.testing.assert_allclose(out, [[-1.0, -1.0, 1.0]], atol=1e-6)
+
+
+def test_import_unsqueeze_multiple_negative_axes(tmp_path):
+    pb, m = _base_model()
+    _add_input(m, "x", (2, 3))
+    ax = m.graph.initializer.add(name="ax", data_type=pb.TensorProto.INT64,
+                                 dims=[2])
+    ax.int64_data.extend([-2, -1])
+    m.graph.node.add(op_type="Unsqueeze", input=["x", "ax"], output=["y"],
+                     name="u0")
+    m.graph.output.add().name = "y"
+    sym2, _, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out, = _eval_symbol(sym2, {"x": nd.array(x)})
+    assert out.shape == (2, 3, 1, 1)
+    np.testing.assert_array_equal(out.reshape(2, 3), x)

@@ -582,3 +582,42 @@ def test_misc_tranche2():
 def test_registry_count_tranche2():
     from mxtpu.ndarray.ops import OP_REGISTRY
     assert len(OP_REGISTRY) >= 325, len(OP_REGISTRY)
+
+
+def test_deconvolution_vs_torch():
+    """Deconvolution (incl. dilation — the r5 ONNX review found dilate
+    was silently ignored) against torch.conv_transpose2d ground truth."""
+    import torch
+    import torch.nn.functional as F
+    x = randn(2, 3, 8, 8)
+    w = randn(3, 4, 3, 3)  # IOHW, the torch conv_transpose layout too
+    b = randn(4)
+    for stride, pad, adj, dil in [((1, 1), (0, 0), (0, 0), (1, 1)),
+                                  ((2, 2), (1, 1), (1, 1), (1, 1)),
+                                  ((2, 2), (1, 1), (0, 0), (2, 2)),
+                                  ((1, 1), (2, 2), (0, 0), (3, 3))]:
+        got = nd.Deconvolution(mx.nd.array(x), mx.nd.array(w),
+                               mx.nd.array(b), kernel=(3, 3),
+                               stride=stride, pad=pad, adj=adj, dilate=dil,
+                               num_filter=4, no_bias=False)
+        ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                                 torch.from_numpy(b), stride=stride,
+                                 padding=pad, output_padding=adj,
+                                 dilation=dil)
+        assert got.shape == tuple(ref.shape), (stride, pad, adj, dil)
+        onp.testing.assert_allclose(got.asnumpy(), ref.numpy(),
+                                    atol=1e-4, rtol=1e-4)
+
+
+def test_deconvolution_grouped_vs_torch():
+    import torch
+    import torch.nn.functional as F
+    x = randn(2, 4, 6, 6)
+    w = randn(4, 3, 3, 3)  # groups=2: (in, out/groups, kH, kW)
+    got = nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                           stride=(2, 2), pad=(1, 1), num_group=2,
+                           num_filter=6, no_bias=True)
+    ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=(2, 2), padding=(1, 1), groups=2)
+    onp.testing.assert_allclose(got.asnumpy(), ref.numpy(),
+                                atol=1e-4, rtol=1e-4)
