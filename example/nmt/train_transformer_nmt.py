@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Encoder-decoder transformer for sequence-to-sequence translation
+(reference ``example/neural_machine_translation`` / GluonNLP NMT
+[path cite — unverified]): the one architecture family example/ was
+missing — BERT is encoder-only, Llama is decoder-only; this wires
+ENCODER + DECODER with cross-attention, teacher-forced training, and
+autoregressive GREEDY DECODE at inference.
+
+Synthetic, solvable target: "translate" a random token sequence into
+its REVERSE — a mapping a seq2seq model can only learn through
+attention (each output position must attend to a different input
+position). After training, greedy decode on held-out sequences must
+exceed 95% token accuracy — asserted.
+
+TPU notes: the whole teacher-forced step is one hybridized program
+(MXU-friendly batched matmuls, static shapes); greedy decode re-runs
+the decoder on the growing prefix — fine at these lengths, and the
+KV-cached path for long sequences lives in ``mxtpu.models.llama``.
+"""
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+SMOKE = bool(int(os.environ.get("MXTPU_SMOKE", "0")))
+
+BOS = 1  # vocab: 0=pad, 1=BOS, 2=EOS, 3.. = symbols
+EOS = 2
+OFFSET = 3
+
+
+def make_pairs(rng, n, seq_len, n_sym):
+    src = rng.integers(OFFSET, OFFSET + n_sym, (n, seq_len))
+    tgt = src[:, ::-1].copy()
+    return src.astype(np.float32), tgt.astype(np.float32)
+
+
+def build(nn):
+    import mxtpu as mx
+    from mxtpu.gluon import HybridBlock
+
+    class MHA(HybridBlock):
+        def __init__(self, dim, heads, **kw):
+            super().__init__(**kw)
+            self._h, self._dh = heads, dim // heads
+            with self.name_scope():
+                self.q = nn.Dense(dim, use_bias=False, flatten=False)
+                self.k = nn.Dense(dim, use_bias=False, flatten=False)
+                self.v = nn.Dense(dim, use_bias=False, flatten=False)
+                self.o = nn.Dense(dim, use_bias=False, flatten=False)
+
+        def hybrid_forward(self, F, q, kv, mask):
+            # mask: (B, 1, 1, Tk) padding or (B, 1, Tq, Tk) causal —
+            # broadcasts over the head axis of the 4-D scores
+            B, Tq, _ = q.shape
+            Tk = kv.shape[1]
+
+            def split(x, T):  # (B, T, D) → (B, H, T, Dh)
+                return F.transpose(x.reshape(B, T, self._h, self._dh),
+                                   axes=(0, 2, 1, 3))
+
+            qh, kh, vh = (split(self.q(q), Tq), split(self.k(kv), Tk),
+                          split(self.v(kv), Tk))
+            scores = F.batch_dot(qh, kh, transpose_b=True) / \
+                math.sqrt(self._dh)
+            scores = F.broadcast_add(scores, mask)
+            ctx = F.batch_dot(F.softmax(scores, axis=-1), vh)
+            ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
+            return self.o(ctx.reshape(B, Tq, self._h * self._dh))
+
+    class Layer(HybridBlock):
+        def __init__(self, dim, heads, cross=False, **kw):
+            super().__init__(**kw)
+            self._cross = cross
+            with self.name_scope():
+                self.ln1 = nn.LayerNorm()
+                self.attn = MHA(dim, heads)
+                if cross:
+                    self.ln_x = nn.LayerNorm()
+                    self.xattn = MHA(dim, heads)
+                self.ln2 = nn.LayerNorm()
+                self.ff1 = nn.Dense(dim * 4, activation="relu",
+                                    flatten=False)
+                self.ff2 = nn.Dense(dim, flatten=False)
+
+        def hybrid_forward(self, F, x, self_mask, *mem_args):
+            h = self.ln1(x)
+            x = x + self.attn(h, h, self_mask)
+            if self._cross:
+                memory, mem_mask = mem_args
+                x = x + self.xattn(self.ln_x(x), memory, mem_mask)
+            return x + self.ff2(self.ff1(self.ln2(x)))
+
+    class Seq2Seq(HybridBlock):
+        def __init__(self, vocab, dim, heads, n_layers, max_len, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.src_emb = nn.Embedding(vocab, dim)
+                self.tgt_emb = nn.Embedding(vocab, dim)
+                self.src_pos = nn.Embedding(max_len, dim)
+                self.tgt_pos = nn.Embedding(max_len, dim)
+                self.enc = [Layer(dim, heads) for _ in range(n_layers)]
+                self.dec = [Layer(dim, heads, cross=True)
+                            for _ in range(n_layers)]
+                for i, l in enumerate(self.enc):
+                    self.register_child(l, f"enc{i}")
+                for i, l in enumerate(self.dec):
+                    self.register_child(l, f"dec{i}")
+                self.ln_f = nn.LayerNorm()
+                self.proj = nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, src, tgt_in, pos_s, pos_t,
+                           zero_mask, causal_mask):
+            mem = self.src_emb(src) + self.src_pos(pos_s)
+            for l in self.enc:
+                mem = l(mem, zero_mask)
+            y = self.tgt_emb(tgt_in) + self.tgt_pos(pos_t)
+            for l in self.dec:
+                y = l(y, causal_mask, mem, zero_mask)
+            return self.proj(self.ln_f(y))
+
+    return Seq2Seq
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=8 if SMOKE else 12)
+    p.add_argument("--n-sym", type=int, default=12 if SMOKE else 20)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--steps", type=int, default=250 if SMOKE else 800)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=3e-3)
+    args = p.parse_args()
+    vocab = OFFSET + args.n_sym
+    t_len = args.seq_len + 1  # BOS + reversed tokens / tokens + EOS
+
+    import mxtpu as mx
+    from mxtpu import gluon, nd
+    from mxtpu.gluon import nn
+
+    from mxtpu.parallel import mesh as pmesh
+    from mxtpu.parallel.sharding import ShardingRules, P
+
+    Seq2Seq = build(nn)
+    net = Seq2Seq(vocab, args.dim, args.heads, args.layers,
+                  max_len=t_len)
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    ce = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+
+    rng = np.random.default_rng(3)
+    pos_s = nd.array(np.tile(np.arange(args.seq_len), (args.batch_size, 1))
+                     .astype(np.float32))
+    pos_t = nd.array(np.tile(np.arange(t_len), (args.batch_size, 1))
+                     .astype(np.float32))
+    # masks carry the batch dim (fused-step args shard/microbatch along
+    # dim 0) and a singleton head axis
+    zero_mask = nd.zeros((args.batch_size, 1, 1, args.seq_len))
+    causal = np.triu(np.full((t_len, t_len), -1e9, np.float32), k=1)
+    causal_mask = nd.array(np.tile(causal[None, None],
+                                   (args.batch_size, 1, 1, 1)))
+
+    net(nd.array(make_pairs(rng, args.batch_size, args.seq_len,
+                            args.n_sym)[0]),
+        nd.array(np.zeros((args.batch_size, t_len), np.float32)),
+        pos_s, pos_t, zero_mask, causal_mask)  # resolve deferred shapes
+    mesh = pmesh.create_mesh(dp=-1)
+    net.shard(mesh, ShardingRules([(r".*", P())]))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    # one donated XLA program per step (fwd+bwd+Adam): a
+    # tunnel-attached chip would crawl under eager per-param updates
+    fused = trainer.make_fused_step(
+        net, loss_fn=lambda out, y: ce(out, y).mean(), loss_args=1)
+
+    for step in range(args.steps):
+        src, tgt = make_pairs(rng, args.batch_size, args.seq_len,
+                              args.n_sym)
+        tgt_in = np.concatenate(
+            [np.full((args.batch_size, 1), BOS, np.float32), tgt], 1)
+        tgt_out = np.concatenate(
+            [tgt, np.full((args.batch_size, 1), EOS, np.float32)], 1)
+        loss = fused(nd.array(src), nd.array(tgt_in), pos_s, pos_t,
+                     zero_mask, causal_mask, nd.array(tgt_out))
+        if step % 100 == 0:
+            print(f"step {step}: loss {float(loss.asscalar()):.4f}")
+
+    # held-out greedy decode: feed back argmax token by token
+    src, tgt = make_pairs(np.random.default_rng(99), args.batch_size,
+                          args.seq_len, args.n_sym)
+    out = np.full((args.batch_size, t_len), BOS, np.float32)
+    for t in range(args.seq_len):
+        logits = net(nd.array(src), nd.array(out), pos_s, pos_t,
+                     zero_mask, causal_mask)
+        nxt = logits.asnumpy()[:, t, :].argmax(-1)
+        out[:, t + 1] = nxt
+    acc = float((out[:, 1:args.seq_len + 1] == tgt).mean())
+    print(f"greedy decode token accuracy on held-out: {acc:.3f}")
+    assert acc > 0.95, acc
+    print("transformer-nmt OK")
+
+
+if __name__ == "__main__":
+    main()

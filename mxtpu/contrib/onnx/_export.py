@@ -261,13 +261,24 @@ def _deconv(b, node, ins, outs):
 @converts("FullyConnected")
 def _fc(b, node, ins, outs):
     data = ins[0]
+    no_bias = node.attrs.get("no_bias", False) or len(ins) < 3
+    shp = b.shape_of(data)
     if node.attrs.get("flatten", True):
-        shp = b.shape_of(data)
         if shp is None or len(shp) != 2:
             flat = b.unique(node.name + "_flat")
             b.add_node("Flatten", [data], [flat], axis=1)
             data = flat
-    no_bias = node.attrs.get("no_bias", False) or len(ins) < 3
+    elif shp is None or len(shp) != 2:
+        # ONNX Gemm is 2-D only; N-D flatten=False lowers to
+        # MatMul(data, Wᵀ) (+ Add bias), which broadcasts over batch dims
+        wt = b.unique(node.name + "_wt")
+        b.add_node("Transpose", [ins[1]], [wt], perm=[1, 0])
+        mm_out = outs if no_bias else [b.unique(node.name + "_mm")]
+        b.add_node("MatMul", [data, wt], mm_out,
+                   name=None if no_bias else node.name + "_matmul")
+        if not no_bias:
+            b.add_node("Add", [mm_out[0], ins[2]], outs, name=node.name)
+        return
     gemm_in = [data, ins[1]] + ([] if no_bias else [ins[2]])
     b.add_node("Gemm", gemm_in, outs, name=node.name,
                alpha=1.0, beta=1.0, transA=0, transB=1)
@@ -371,6 +382,20 @@ def _batchnorm(b, node, ins, outs):
     if int(node.attrs.get("axis", 1)) != 1:
         raise ValueError("BatchNorm(axis != 1) not exportable — ONNX "
                          "BatchNormalization is defined over axis 1 only")
+    if node.attrs.get("fix_gamma", False):
+        # reference semantic: gamma is pinned to 1 regardless of its
+        # stored value. Emit a FRESH ones initializer for THIS node —
+        # rewriting the original tensor would also change any other
+        # consumer of the same value.
+        shp = b.shape_of(ins[1])
+        if shp is None:
+            raise ValueError(
+                f"BatchNorm(fix_gamma=True) export needs gamma's shape "
+                f"({node.name})")
+        dt = b.dtype_of(ins[1]) or _np.dtype(_np.float32)
+        ins = list(ins)
+        ins[1] = b.add_initializer(node.name + "_fixed_gamma",
+                                   _np.ones(shp, dtype=dt))
     b.add_node("BatchNormalization", ins, outs, name=node.name,
                epsilon=float(node.attrs.get("eps", 1e-5)),
                momentum=float(node.attrs.get("momentum", 0.9)))
@@ -620,9 +645,12 @@ def _split(b, node, ins, outs):
 
 @converts("slice")
 def _slice(b, node, ins, outs):
-    begin = [int(x) for x in node.attrs["begin"]]
+    begin = [0 if x is None else int(x) for x in node.attrs["begin"]]
     end = [2 ** 62 if e is None else int(e) for e in node.attrs["end"]]
     step = node.attrs.get("step")
+    if step and any(s is not None and int(s) < 0 for s in step):
+        # the open-end sentinel below is wrong under reversed traversal
+        raise ValueError("slice with negative step is not exportable")
     inputs = [ins[0],
               b.i64(node.name + "_starts", begin),
               b.i64(node.name + "_ends", end),
@@ -703,11 +731,29 @@ _REDUCE = {"mean": "ReduceMean", "max": "ReduceMax", "min": "ReduceMin",
            "prod": "ReduceProd"}
 
 
-def _reduce(b, node, ins, outs):
+def _reduce_axes(b, node, ins):
+    """Resolve the mxtpu axis/exclude attrs to explicit ONNX axes
+    (None = reduce all)."""
     ax = node.attrs.get("axis")
+    if ax is None:
+        return None  # reduce all (exclude has no effect without axis)
+    axes = [int(ax)] if isinstance(ax, int) else [int(a) for a in ax]
+    if node.attrs.get("exclude"):
+        shp = b.shape_of(ins[0])
+        if shp is None:
+            raise ValueError(
+                f"{node.op}(exclude=True) export needs inferred shapes")
+        nd_ = len(shp)
+        listed = {a % nd_ for a in axes}
+        axes = [i for i in range(nd_) if i not in listed]
+    return axes
+
+
+def _reduce(b, node, ins, outs):
+    axes = _reduce_axes(b, node, ins)
     kw = {"keepdims": int(bool(node.attrs.get("keepdims", False)))}
-    if ax is not None:
-        kw["axes"] = [ax] if isinstance(ax, int) else [int(a) for a in ax]
+    if axes is not None:
+        kw["axes"] = axes
     b.add_node(_REDUCE[node.op], ins[:1], outs, name=node.name, **kw)
 
 
@@ -718,11 +764,10 @@ for _name in _REDUCE:
 @converts("sum")
 def _reduce_sum(b, node, ins, outs):
     # opset 13 moved ReduceSum's axes from attr to input
-    ax = node.attrs.get("axis")
+    axes = _reduce_axes(b, node, ins)
     inputs = [ins[0]]
-    if ax is not None:
-        axes = [ax] if isinstance(ax, int) else list(ax)
-        inputs.append(b.i64(node.name + "_axes", [int(a) for a in axes]))
+    if axes is not None:
+        inputs.append(b.i64(node.name + "_axes", axes))
     b.add_node("ReduceSum", inputs, outs, name=node.name,
                keepdims=int(bool(node.attrs.get("keepdims", False))))
 
@@ -770,7 +815,7 @@ def _batch_dot(b, node, ins, outs):
 
 
 # -- graph-level export ------------------------------------------------------
-def _onnx_value_names(node, index_of) -> List[str]:
+def _onnx_value_names(node) -> List[str]:
     n_out = node.num_outputs or 1
     return [node.name if i == 0 else f"{node.name}_out{i}"
             for i in range(n_out)]
@@ -825,20 +870,10 @@ def export_graph(sym, params: Dict[str, Any],
                 b.inputs.append(b.value_info(node.name, st))
                 b._struct_of[node.name] = st
 
-    # fix_gamma: reference BatchNorm semantic — gamma is pinned to 1
-    for node in nodes:
-        if node.op == "BatchNorm" and node.attrs.get("fix_gamma", False):
-            gnode, gidx = node.inputs[1]
-            gname = gnode.name
-            for t in b.initializers:
-                if t.name == gname:
-                    arr = _np.ones_like(tensor_to_np(t))
-                    t.CopyFrom(make_tensor(gname, arr))
-
     for node in nodes:
         if node.is_var():
             continue
-        outs = _onnx_value_names(node, None)
+        outs = _onnx_value_names(node)
         for i, o in enumerate(outs):
             value_names[(id(node), i)] = o
             st = entry_structs.get((id(node), i))

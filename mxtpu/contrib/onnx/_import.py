@@ -50,6 +50,12 @@ class _Ctx:
         return self.consts.get(name) if name else None
 
 
+def _opt(ins, i):
+    """i-th input or None — ONNX encodes absent optional inputs as ""
+    (mapped to None), so presence means BOTH in range and non-None."""
+    return ins[i] if len(ins) > i and ins[i] is not None else None
+
+
 def _attrs(node) -> Dict[str, Any]:
     out = {}
     for a in node.attribute:
@@ -109,12 +115,12 @@ def _conv(ctx, node, ins, at):
         data = ctx.sym.pad(data, mode="constant", pad_width=tuple(pw))
     num_filter = w.shape[0] if w is not None else None
     return ctx.sym.Convolution(
-        data, ins[1], None if len(ins) < 3 else ins[2],
+        data, ins[1], _opt(ins, 2),
         kernel=tuple(int(k) for k in kernel),
         stride=tuple(at.get("strides", [1] * nd)),
         dilate=tuple(at.get("dilations", [1] * nd)),
         pad=tuple(pad), num_filter=num_filter, num_group=group,
-        no_bias=len(ins) < 3)
+        no_bias=_opt(ins, 2) is None)
 
 
 @imports("ConvTranspose")
@@ -130,14 +136,14 @@ def _conv_transpose(ctx, node, ins, at):
     if pw is not None:
         raise ValueError("asymmetric ConvTranspose pads unsupported")
     return ctx.sym.Deconvolution(
-        ins[0], ins[1], None if len(ins) < 3 else ins[2],
+        ins[0], ins[1], _opt(ins, 2),
         kernel=tuple(int(k) for k in kernel),
         stride=tuple(at.get("strides", [1] * nd)),
         dilate=tuple(at.get("dilations", [1] * nd)),
         pad=tuple(pad),
         adj=tuple(at.get("output_padding", [0] * nd)),
         num_group=int(at.get("group", 1)),
-        no_bias=len(ins) < 3)
+        no_bias=_opt(ins, 2) is None)
 
 
 @imports("Gemm")
@@ -146,14 +152,14 @@ def _gemm(ctx, node, ins, at):
     transA, transB = at.get("transA", 0), at.get("transB", 0)
     if alpha == 1.0 and beta == 1.0 and not transA and transB:
         return ctx.sym.FullyConnected(
-            ins[0], ins[1], None if len(ins) < 3 else ins[2],
-            no_bias=len(ins) < 3, flatten=False)
+            ins[0], ins[1], _opt(ins, 2),
+            no_bias=_opt(ins, 2) is None, flatten=False)
     a, b = ins[0], ins[1]
     y = ctx.sym.dot(a, b, transpose_a=bool(transA), transpose_b=bool(transB))
     if alpha != 1.0:
         y = y * alpha
-    if len(ins) > 2:
-        c = ins[2]
+    c = _opt(ins, 2)
+    if c is not None:
         y = ctx.sym.broadcast_add(y, c * beta if beta != 1.0 else c)
     return y
 
@@ -259,7 +265,8 @@ def _bn(ctx, node, ins, at):
 def _ln(ctx, node, ins, at):
     return ctx.sym.LayerNorm(
         ins[0], ins[1],
-        ins[2] if len(ins) > 2 else ctx.sym.zeros_like(ins[1]),
+        _opt(ins, 2) if _opt(ins, 2) is not None
+        else ctx.sym.zeros_like(ins[1]),
         axis=at.get("axis", -1), eps=at.get("epsilon", 1e-5))
 
 
@@ -451,14 +458,21 @@ def _slice(ctx, node, ins, at):
     for s, e, a, st in zip(starts, ends, axes, steps):
         if int(st) != 1:
             raise ValueError("Slice with step != 1 unsupported")
-        e = None if int(e) >= big else int(e)
-        y = ctx.sym.slice_axis(y, axis=int(a), begin=int(s), end=e)
+        if int(s) == 0 and int(e) >= big:
+            continue  # full-range no-op on this axis
+        # python-slice clamping makes an over-large end equal open-ended
+        y = ctx.sym.slice_axis(y, axis=int(a), begin=int(s),
+                               end=min(int(e), big - 1))
     return y
 
 
 @imports("Gather")
 def _gather(ctx, node, ins, at):
-    return ctx.sym.take(ins[0], ins[1], axis=at.get("axis", 0))
+    # mode="wrap": ONNX Gather allows negative (from-the-end) indices,
+    # which modulo-wrap reproduces; the take default "clip" would clamp
+    # them to index 0
+    return ctx.sym.take(ins[0], ins[1], axis=at.get("axis", 0),
+                        mode="wrap")
 
 
 @imports("Where")
@@ -490,6 +504,10 @@ for _t in _RED:
 
 @imports("Pad")
 def _pad(ctx, node, ins, at):
+    if len(node.input) > 3 and node.input[3]:
+        # opset 18 added an axes input (pads cover only those axes) —
+        # len(pads)//2 below would pad the wrong dims silently
+        raise ValueError("Pad with axes input unsupported")
     if len(node.input) > 1:
         pads = ctx.const(node.input[1], "Pad pads")
         cval = ctx.const(node.input[2], "Pad constant_value") \
@@ -581,8 +599,13 @@ def import_graph(model: _pb.ModelProto):
         out = fn(ctx, n, ins, at)
         if isinstance(out, (list, tuple)):
             outs = list(out)
-        elif len(n.output) > 1:  # multi-entry Symbol (e.g. Split)
-            outs = [out[i] for i in range(len(n.output))]
+        elif len(n.output) > 1:
+            # multi-entry Symbol (e.g. Split) — but a node may also
+            # declare OPTIONAL extra outputs (Dropout mask, MaxPool
+            # indices) the converter doesn't produce; leave those
+            # unbound so only an actual consumer errors, by name
+            n_avail = len(out)
+            outs = [out[i] for i in range(min(len(n.output), n_avail))]
         else:
             outs = [out]
         for name, s in zip(n.output, outs):

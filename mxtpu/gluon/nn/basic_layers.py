@@ -411,7 +411,10 @@ class HybridConcatenate(HybridBlock):
         return nd.concat(*out, dim=self.axis)
 
     def hybrid_forward(self, F, x):
-        return self.forward(x)
+        # F-aware so the children's outputs (Symbols under a symbolic
+        # trace) concat through the registry op, not jnp directly
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
 
 
 class Concatenate(Block):

@@ -481,3 +481,146 @@ def test_import_unsqueeze_multiple_negative_axes(tmp_path):
     out, = _eval_symbol(sym2, {"x": nd.array(x)})
     assert out.shape == (2, 3, 1, 1)
     np.testing.assert_array_equal(out.reshape(2, 3), x)
+
+
+def test_reduce_exclude_roundtrip(tmp_path):
+    data = sym.var("data")
+    out = sym.Group([sym.sum(data, axis=1, exclude=True, keepdims=True),
+                     sym.mean(data, axis=(0, 2), exclude=True)])
+    x = np.random.RandomState(11).randn(2, 3, 4).astype(np.float32)
+    _roundtrip(out, {}, {"data": x}, tmp_path)
+
+
+def test_fc_no_flatten_3d_roundtrip(tmp_path):
+    rng = np.random.RandomState(12)
+    data = sym.var("data")
+    w, bias = sym.var("w"), sym.var("b")
+    out = sym.FullyConnected(data, w, bias, num_hidden=5, flatten=False)
+    params = {"w": nd.array(rng.randn(5, 4).astype(np.float32)),
+              "b": nd.array(rng.randn(5).astype(np.float32))}
+    x = rng.randn(2, 3, 4).astype(np.float32)  # rank 3: MatMul path
+    _roundtrip(out, params, {"data": x}, tmp_path)
+
+
+def test_slice_none_begin_roundtrip(tmp_path):
+    data = sym.var("data")
+    out = sym.slice(data, begin=(None, 1), end=(None, 3))
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    _roundtrip(out, {}, {"data": x}, tmp_path)
+    bad = sym.slice(data, begin=(None,), end=(None,), step=(-1,))
+    with pytest.raises(ValueError, match="negative step"):
+        onnx_mxtpu.export_model(bad, {}, input_shapes={"data": (2, 4)},
+                                onnx_file=str(tmp_path / "neg.onnx"))
+
+
+def test_import_gather_negative_indices(tmp_path):
+    pb, m = _base_model()
+    _add_input(m, "x", (5,))
+    idx = m.graph.initializer.add(name="idx",
+                                  data_type=pb.TensorProto.INT64, dims=[2])
+    idx.int64_data.extend([-1, 0])
+    m.graph.node.add(op_type="Gather", input=["x", "idx"], output=["y"],
+                     name="g0")
+    m.graph.output.add().name = "y"
+    sym2, args, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    binds = dict(args)
+    binds["x"] = nd.array(np.array([10., 20., 30., 40., 50.], np.float32))
+    out, = _eval_symbol(sym2, binds)
+    np.testing.assert_array_equal(out, [50.0, 10.0])  # -1 = last, not 0
+
+
+def test_import_dropout_with_unused_mask_output(tmp_path):
+    """Training-exported files declare Dropout's optional mask output;
+    importing must not crash when no converter output backs it."""
+    pb, m = _base_model()
+    _add_input(m, "x", (2, 3))
+    m.graph.node.add(op_type="Dropout", input=["x"],
+                     output=["y", "mask"], name="d0")
+    m.graph.node.add(op_type="Relu", input=["y"], output=["z"],
+                     name="r0")
+    m.graph.output.add().name = "z"
+    sym2, _, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    x = np.array([[-1.0, 2.0, -3.0]], np.float32)
+    out, = _eval_symbol(sym2, {"x": nd.array(x)})
+    np.testing.assert_array_equal(out, [[0.0, 2.0, 0.0]])
+
+
+# one representative per model-zoo family — every family must export,
+# re-import, and match numerically (the reference mx2onnx's model-zoo
+# coverage claim, SURVEY §2.2 ONNX row)
+_ZOO_FAMILIES = ["resnet18_v1", "resnet18_v2", "vgg11_bn", "alexnet",
+                 "densenet121", "squeezenet1.0", "inceptionv3",
+                 "mobilenet0.25", "mobilenetv2_0.25"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _ZOO_FAMILIES)
+def test_model_zoo_family_onnx_roundtrip(name, tmp_path):
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.get_model(name)
+    net.initialize()
+    # densenet/inception end in fixed-size AvgPool (upstream parity) —
+    # they only accept their canonical input sizes
+    size = {"inceptionv3": 299, "densenet121": 224}.get(name, 64)
+    x = nd.array(np.random.RandomState(13).rand(1, 3, size, size)
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / f"{name.replace('.', '_')}.onnx")
+    onnx_mxtpu.export_model(net, input_shapes=[(1, 3, size, size)],
+                            onnx_file=path)
+    block = onnx_mxtpu.import_to_gluon(path)
+    got = block(x).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=1e-4, rtol=1e-4)
+
+
+def test_batchnorm_fix_gamma_roundtrip(tmp_path):
+    """fix_gamma pins gamma to 1 via a FRESH initializer (the stored
+    gamma value must be ignored, and other consumers unaffected)."""
+    rng = np.random.RandomState(21)
+    data = sym.var("data")
+    g, b_, mm, mv = (sym.var(n) for n in ("g", "b", "mm", "mv"))
+    bn = sym.BatchNorm(data, g, b_, mm, mv, fix_gamma=True,
+                       use_global_stats=True)
+    # second consumer of gamma proves the original initializer survives
+    out = sym.Group([bn, sym.identity(g)])
+    params = {"g": nd.array(np.full(3, 7.0, np.float32)),
+              "b": nd.array(rng.randn(3).astype(np.float32)),
+              "mm": nd.array(rng.randn(3).astype(np.float32) * 0.1),
+              "mv": nd.array(rng.rand(3).astype(np.float32) + 0.5)}
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    _roundtrip(out, params, {"data": x}, tmp_path)
+
+
+def test_import_empty_optional_bias(tmp_path):
+    """ONNX encodes an absent optional input as "" — Conv with
+    input=[x, W, ""] must import as no_bias, not a phantom bias var."""
+    pb, m = _base_model()
+    _add_input(m, "x", (1, 1, 4, 4))
+    w = m.graph.initializer.add(name="w", data_type=pb.TensorProto.FLOAT,
+                                dims=[2, 1, 3, 3])
+    w.raw_data = np.ones((2, 1, 3, 3), np.float32).tobytes()
+    m.graph.node.add(op_type="Conv", input=["x", "w", ""], output=["y"],
+                     name="conv0")
+    m.graph.output.add().name = "y"
+    sym2, args, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    assert set(sym2.list_arguments()) == {"x", "w"}  # no phantom bias
+    binds = dict(args)
+    binds["x"] = nd.array(np.ones((1, 1, 4, 4), np.float32))
+    out, = _eval_symbol(sym2, binds)
+    assert out.shape == (1, 2, 2, 2)
+
+
+def test_import_pad_axes_input_raises(tmp_path):
+    pb, m = _base_model()
+    _add_input(m, "x", (1, 1, 4, 4))
+    pads = m.graph.initializer.add(name="p", data_type=pb.TensorProto.INT64,
+                                   dims=[4])
+    pads.int64_data.extend([1, 1, 1, 1])
+    axes = m.graph.initializer.add(name="ax", data_type=pb.TensorProto.INT64,
+                                   dims=[2])
+    axes.int64_data.extend([2, 3])
+    m.graph.node.add(op_type="Pad", input=["x", "p", "", "ax"],
+                     output=["y"], name="pad0")
+    m.graph.output.add().name = "y"
+    with pytest.raises(ValueError, match="axes"):
+        onnx_mxtpu.import_model(_load(m, tmp_path))

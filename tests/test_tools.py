@@ -644,8 +644,11 @@ def test_example_walker_sees_known_suites():
     """If the glob rots, fail loudly instead of silently gating
     nothing."""
     scripts = _example_scripts()
-    assert len(scripts) >= 19, scripts
+    assert len(scripts) >= 25, scripts
     assert "example/moe/train_moe.py" in scripts
+    assert "example/nmt/train_transformer_nmt.py" in scripts
+    assert "example/neural-style/neural_style.py" in scripts
+    assert "example/recommenders/matrix_fact.py" in scripts
     for k in list(_EXAMPLE_ARGV) + list(_EXAMPLE_LAUNCHED):
         assert k in scripts, f"stale config entry {k}"
 
