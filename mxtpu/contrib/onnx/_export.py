@@ -115,6 +115,11 @@ class GraphBuilder:
         self.outputs: List[_pb.ValueInfoProto] = []
         self._names_used: set = set()
         self._struct_of: Dict[str, Any] = {}  # value name → ShapeDtypeStruct
+        # constant values known at export time (params + folded nodes);
+        # materialized as initializers lazily, only when referenced by an
+        # emitted node or a graph output
+        self.const_np: Dict[str, _np.ndarray] = {}
+        self.zero_states: set = set()  # _rnn_init_state outputs
 
     # -- naming ---------------------------------------------------------
     def unique(self, hint: str) -> str:
@@ -795,6 +800,134 @@ def _dot(b, node, ins, outs):
     b.add_node("MatMul", [a, c], outs, name=node.name)
 
 
+@converts("RNN")
+def _rnn(b, node, ins, outs):
+    """Fused RNN → ONNX LSTM/GRU/RNN, one node per layer.
+
+    The cuDNN-packed 1-D parameter vector is unpacked via the op's own
+    ``rnn_param_layout`` — constant folding has already collapsed the
+    gluon-side reshape/concat packing chain, so ``ins[1]`` is a known
+    constant. Gate orders are remapped (ours i,f,g,o / r,z,n → ONNX
+    i,o,f,c / z,r,h); GRU exports with ``linear_before_reset=1``, the
+    cuDNN semantic this op implements."""
+    from ...ndarray.ops import rnn_param_layout, rnn_gates
+
+    mode = node.attrs.get("mode", "lstm").lower()
+    if node.attrs.get("projection_size") is not None:
+        raise ValueError("RNN projection_size not exportable")
+    if node.attrs.get("lstm_state_clip_min") is not None or \
+            node.attrs.get("lstm_state_clip_max") is not None:
+        raise ValueError("RNN state clipping not exportable")
+    L = int(node.attrs.get("num_layers", 1))
+    bi = bool(node.attrs.get("bidirectional", False))
+    H = int(node.attrs["state_size"])
+    d = 2 if bi else 1
+    is_lstm = mode == "lstm"
+    pvec = b.const_np.get(ins[1])
+    if pvec is None:
+        raise ValueError("RNN export needs compile-time-constant "
+                         "parameters (an initializer or foldable chain)")
+    shp = b.shape_of(ins[0])
+    if shp is None:
+        raise ValueError("RNN export needs the inferred input shape")
+    T, N, C = (int(x) for x in shp)
+    ng = rnn_gates(mode)
+    layout, total = rnn_param_layout(mode, C, H, L, bi)
+    pvec = _np.asarray(pvec).reshape(-1)
+    if pvec.shape[0] != total:
+        raise ValueError(f"RNN parameters size {pvec.shape[0]} != "
+                         f"expected {total}")
+
+    def get(kind, layer, dr):
+        off, shape = layout[(kind, layer, dr)]
+        n = int(_np.prod(shape))
+        return pvec[off:off + n].reshape(shape)
+
+    def reorder(w):  # rows grouped per gate, our order → ONNX order
+        if mode == "lstm":  # i,f,g,o → i,o,f,c(=g)
+            i, f, g, o = _np.split(w, 4, axis=0)
+            return _np.concatenate([i, o, f, g], axis=0)
+        if mode == "gru":  # r,z,n → z,r,h(=n)
+            r, z, n_ = _np.split(w, 3, axis=0)
+            return _np.concatenate([z, r, n_], axis=0)
+        return w
+
+    onnx_op = {"lstm": "LSTM", "gru": "GRU",
+               "rnn_tanh": "RNN", "rnn_relu": "RNN"}[mode]
+    h0_given = ins[2] not in b.zero_states
+    c0_given = is_lstm and len(ins) > 3 and ins[3] not in b.zero_states
+
+    def layer_state(src, layer, hint):
+        if L == 1:
+            return src
+        sl = b.unique(f"{node.name}_{hint}{layer}")
+        b.add_node("Slice",
+                   [src, b.i64(f"{sl}_starts", [layer * d]),
+                    b.i64(f"{sl}_ends", [(layer + 1) * d]),
+                    b.i64(f"{sl}_axes", [0])], [sl])
+        return sl
+
+    cur = ins[0]
+    hts, cts = [], []
+    for layer in range(L):
+        W = _np.stack([reorder(get("i2h_weight", layer, dr))
+                       for dr in range(d)])
+        R = _np.stack([reorder(get("h2h_weight", layer, dr))
+                       for dr in range(d)])
+        Bv = _np.stack([_np.concatenate(
+            [reorder(get("i2h_bias", layer, dr)[:, None])[:, 0],
+             reorder(get("h2h_bias", layer, dr)[:, None])[:, 0]])
+            for dr in range(d)])
+        inputs = [cur,
+                  b.add_initializer(f"{node.name}_W{layer}", W),
+                  b.add_initializer(f"{node.name}_R{layer}", R),
+                  b.add_initializer(f"{node.name}_B{layer}", Bv),
+                  ""]  # sequence_lens absent
+        if h0_given:
+            inputs.append(layer_state(ins[2], layer, "h0"))
+        elif is_lstm and c0_given:
+            inputs.append("")
+        if is_lstm and c0_given:
+            inputs.append(layer_state(ins[3], layer, "c0"))
+        while inputs and inputs[-1] == "":
+            inputs.pop()
+        y = b.unique(f"{node.name}_Y{layer}")
+        yh = b.unique(f"{node.name}_Yh{layer}")
+        node_outs = [y, yh]
+        if is_lstm:
+            yc = b.unique(f"{node.name}_Yc{layer}")
+            node_outs.append(yc)
+            cts.append(yc)
+        hts.append(yh)
+        kw = dict(hidden_size=H,
+                  direction="bidirectional" if bi else "forward")
+        if mode == "gru":
+            kw["linear_before_reset"] = 1
+        if onnx_op == "RNN":
+            kw["activations"] = \
+                ["Tanh" if mode == "rnn_tanh" else "Relu"] * d
+        b.add_node(onnx_op, inputs, node_outs, **kw)
+        # Y (T, D, N, H) → (T, N, D*H), the fused-op layout
+        tr = b.unique(f"{node.name}_Ytr{layer}")
+        b.add_node("Transpose", [y], [tr], perm=[0, 2, 1, 3])
+        nxt = outs[0] if layer == L - 1 else \
+            b.unique(f"{node.name}_l{layer}")
+        b.add_node("Reshape",
+                   [tr, b.i64(f"{node.name}_yshape{layer}",
+                              [T, N, d * H])], [nxt])
+        cur = nxt
+    if len(outs) > 1:  # final hidden: per-layer (D,N,H) → (L*D, N, H)
+        if len(hts) == 1:
+            b.add_node("Identity", [hts[0]], [outs[1]])
+        else:
+            b.add_node("Concat", hts, [outs[1]], axis=0)
+    if len(outs) > 2:
+        if len(cts) == 1:
+            b.add_node("Identity", [cts[0]], [outs[2]])
+        else:
+            b.add_node("Concat", cts, [outs[2]], axis=0)
+
+
 @converts("batch_dot")
 def _batch_dot(b, node, ins, outs):
     a, c = ins
@@ -854,7 +987,7 @@ def export_graph(sym, params: Dict[str, Any],
             b._names_used.add(node.name)
             if node.name in np_params:
                 arr = np_params[node.name]
-                b.initializers.append(make_tensor(node.name, arr))
+                b.const_np[node.name] = arr
                 b._struct_of[node.name] = jax.ShapeDtypeStruct(
                     arr.shape, arr.dtype)
             else:
@@ -880,12 +1013,52 @@ def export_graph(sym, params: Dict[str, Any],
             if st is not None:
                 b._struct_of[o] = st
         ins = [value_names[(id(p), i)] for p, i in node.inputs]
+        if node.op == "_rnn_init_state":
+            # a zero initial state — the RNN converter omits the
+            # corresponding optional ONNX input (defaults to zeros)
+            b.zero_states.update(outs)
+            continue
+        if _fold_node(b, node, ins, outs):
+            continue
         conv = _CONVERTERS.get(node.op)
         if conv is None:
             raise ValueError(
                 f"op {node.op!r} ({node.name}) has no ONNX converter; "
                 f"supported: {sorted(_CONVERTERS)}")
         conv(b, node, ins, outs)
+
+    # lazily materialize constants (params + folded values) that emitted
+    # nodes or graph outputs actually reference — folding intermediates
+    # (e.g. the RNN packing chain) never hit the file
+    head_names = {value_names[(id(h), i)] for h, i in sym._entries}
+    referenced = set(head_names)
+    for n2 in b.nodes:
+        referenced.update(n2.input)
+    existing = {t.name for t in b.initializers}
+    produced = {o for n2 in b.nodes for o in n2.output}
+    bridge = {n for n in head_names
+              if n in b.const_np and n not in produced}
+    for name in sorted(referenced):
+        if name and name not in existing and name in b.const_np and \
+                name not in bridge:
+            b.initializers.append(
+                make_tensor(name, _np.asarray(b.const_np[name])))
+            existing.add(name)
+    for name in sorted(bridge):
+        # a fully-folded graph output: initializers are not valid
+        # outputs, so bridge with Identity
+        cname = b.unique(name + "_const")
+        b.initializers.append(
+            make_tensor(cname, _np.asarray(b.const_np[name])))
+        b.add_node("Identity", [cname], [name])
+        produced.add(name)
+    inputs_set = {vi.name for vi in b.inputs}
+    for name in sorted(referenced):
+        if name and name not in existing and name not in produced and \
+                name not in inputs_set:
+            raise ValueError(
+                f"value {name!r} is consumed but never produced — "
+                f"likely an unsupported zero-state or optional output")
 
     model = _pb.ModelProto()
     model.ir_version = 8
