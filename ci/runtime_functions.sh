@@ -32,6 +32,14 @@ PYEOF
     echo "sanity_check: OK"
 }
 
+mxlint() {
+    # trace-safety + dispatch static analysis (docs/lint.md): the repo
+    # must lint clean, and the seeded fixtures must all be flagged (the
+    # second half of that contract is the tier-1 tests/test_mxlint.py
+    # gate). Stdlib-only — runs in well under a second.
+    python -m tools.mxlint mxtpu/ example/
+}
+
 unittest_cpu_mesh() {
     # the main suite on the virtual 8-device CPU mesh (conftest forces
     # JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8)
@@ -138,6 +146,7 @@ opperf_baseline() {
 
 ci_all() {
     sanity_check
+    mxlint
     unittest_cpu_mesh
     multichip_dryrun
     bench_smoke

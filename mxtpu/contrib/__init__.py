@@ -25,4 +25,6 @@ def __getattr__(name):
             else f"mxtpu.contrib.{name}")
     if name == "onnx":
         return importlib.import_module("mxtpu.contrib.onnx")
+    if name == "analysis":
+        return importlib.import_module("mxtpu.contrib.analysis")
     raise AttributeError(f"module 'mxtpu.contrib' has no attribute {name!r}")

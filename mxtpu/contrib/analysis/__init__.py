@@ -1,0 +1,22 @@
+"""mxtpu.contrib.analysis — the mxlint static-analysis suite.
+
+Two halves:
+
+- AST rules over Python source (:mod:`.rules`): trace-safety
+  (``MXL001``), tracer-control-flow (``MXL002``), dispatch-count
+  (``MXL003``). Run them with :func:`lint_paths` or the CLI,
+  ``python -m tools.mxlint mxtpu/ example/``.
+- Graph validity over traced ``Symbol`` programs (:mod:`.graph`,
+  ``MXL100``): static shape/dtype inference that reports the first
+  inconsistent node with op name and inferred shapes; reused by the
+  ONNX exporter and exposed as ``Symbol.validate()``.
+
+See docs/lint.md for rule semantics and the suppression syntax.
+"""
+from .rules import (RULES, Finding, iter_python_files, lint_file,
+                    lint_paths, lint_source)
+from .graph import GraphIssue, format_issues, validate_graph
+
+__all__ = ["RULES", "Finding", "lint_source", "lint_file", "lint_paths",
+           "iter_python_files", "GraphIssue", "validate_graph",
+           "format_issues"]

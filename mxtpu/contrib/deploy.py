@@ -15,7 +15,8 @@ __all__ = ["load", "Predictor"]
 def load(path: str) -> "Predictor":
     """Load a ``.stablehlo`` artifact into a callable Predictor."""
     with open(path, "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        from jax import export as _jax_export  # lazy submodule on old jax
+        exported = _jax_export.deserialize(f.read())
     return Predictor(exported)
 
 

@@ -58,7 +58,33 @@ def export_model(sym, params=None, input_shapes=None,
         names = ["data"] if n_in == 1 else [f"data{i}" for i in range(n_in)]
         if isinstance(input_shapes, dict):
             names = list(input_shapes)
-        out = block._trace_symbol(*[sym_mod.var(n) for n in names])
+        try:
+            out = block._trace_symbol(*[sym_mod.var(n) for n in names])
+        except TypeError as e:
+            # only convert GENUINE arity mismatches (forward takes a
+            # different input count than we guessed — the default guess
+            # is one 'data' var), determined from the hybrid_forward
+            # signature, not by sniffing the message; a TypeError from
+            # inside the model body propagates untouched
+            import inspect
+            try:
+                sig = inspect.signature(type(block).hybrid_forward)
+                data_args = [
+                    p.name for p in list(sig.parameters.values())[2:]
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)
+                    and p.default is p.empty
+                    and p.name not in block._reg_params]
+            except (TypeError, ValueError):
+                data_args = None
+            if data_args is None or len(data_args) == len(names):
+                raise
+            raise ValueError(
+                f"export_model: {type(block).__name__}.hybrid_forward "
+                f"takes {len(data_args)} data input(s) {data_args} but "
+                f"{len(names)} were guessed ({names}); pass "
+                f"input_shapes as a dict {{name: shape}} or a list "
+                f"with one shape per forward input") from e
         if isinstance(out, (list, tuple)):
             out = sym_mod.Group(list(out))
         sym = out

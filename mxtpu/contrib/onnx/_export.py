@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
 
+from ...base import MXNetError
 from . import onnx_pb2 as _pb
 
 # dtype name ↔ TensorProto.DataType
@@ -581,6 +582,17 @@ def _flatten(b, node, ins, outs):
     b.add_node("Flatten", ins, outs, name=node.name, axis=1)
 
 
+@converts("reshape_like")
+def _reshape_like(b, node, ins, outs):
+    shp = b.shape_of(ins[1]) or b.shape_of(node.name)
+    if shp is None:
+        raise ValueError("reshape_like export needs inferred shapes")
+    b.add_node("Reshape",
+               [ins[0], b.i64(node.name + "_shape",
+                              [int(x) for x in shp])],
+               outs, name=node.name)
+
+
 @converts("transpose")
 def _transpose(b, node, ins, outs):
     axes = node.attrs.get("axes")
@@ -947,6 +959,54 @@ def _batch_dot(b, node, ins, outs):
     b.add_node("MatMul", [a, c], outs, name=node.name)
 
 
+# -- constant folding --------------------------------------------------------
+# never fold: stochastic ops (one folded sample would freeze the
+# randomness). _rnn_init_state never reaches here — export_graph
+# `continue`s on it before the fold check.
+_NO_FOLD_OPS = {"Dropout"}
+
+
+def _fold_node(b: GraphBuilder, node, ins, outs) -> bool:
+    """Constant-fold one op node: when every input value is already
+    known at export time (a parameter initializer or an earlier folded
+    node), evaluate the op eagerly through the shared op registry and
+    record the results in ``b.const_np`` instead of emitting ONNX nodes.
+
+    This is what collapses the RNN converter's parameter-packing chain
+    (per-layer reshape/concat of the cuDNN-packed vector) into the
+    single constant ``ins[1]`` the converter reads back; folded
+    intermediates never reach the file (the lazy initializer
+    materialization in export_graph only writes referenced names).
+    Returns True when the node was folded (caller skips conversion)."""
+    if not ins or any(i not in b.const_np for i in ins):
+        return False
+    op = node.op
+    low = op.lower()
+    if op in _NO_FOLD_OPS or "random" in low or "sample" in low or \
+            "rand" in low:
+        return False
+    import jax.numpy as jnp
+
+    from ... import autograd as _autograd
+    from ...ndarray import NDArray as _NDArray
+    from ...symbol.symbol import _call_registry_op
+    try:
+        with _autograd.pause():
+            in_nds = [_NDArray(jnp.asarray(b.const_np[i])) for i in ins]
+            results = _call_registry_op(node, in_nds)
+    except Exception:
+        return False  # not evaluable eagerly — emit through a converter
+    if len(results) < len(outs):
+        return False
+    import jax
+    for o, r in zip(outs, results):
+        arr = _np.asarray(r.asnumpy())
+        b.const_np[o] = arr
+        b._struct_of.setdefault(
+            o, jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return True
+
+
 # -- graph-level export ------------------------------------------------------
 def _onnx_value_names(node) -> List[str]:
     n_out = node.num_outputs or 1
@@ -975,7 +1035,19 @@ def export_graph(sym, params: Dict[str, Any],
           for k, v in np_params.items()}
     for k, v in (input_shapes or {}).items():
         kw.setdefault(k, jax.ShapeDtypeStruct(tuple(v), _np.float32))
-    structs = sym._infer_structs(**kw)
+    try:
+        structs = sym._infer_structs(**kw)
+    except MXNetError as e:
+        # re-run as the mxlint graph-validity pass (MXL100) so the
+        # failure names the first inconsistent node with its op and
+        # inferred input shapes, instead of a deep trace-internal error
+        from ..analysis.graph import format_issues, validate_graph
+        issues = validate_graph(sym, params=np_params,
+                                input_shapes=input_shapes)
+        detail = format_issues(issues) if issues else str(e)
+        raise ValueError(
+            f"ONNX export aborted — graph failed validation:\n{detail}"
+        ) from e
     entry_structs = {}
     if structs is not None:
         entry_structs, var_structs = structs
@@ -1027,13 +1099,26 @@ def export_graph(sym, params: Dict[str, Any],
                 f"supported: {sorted(_CONVERTERS)}")
         conv(b, node, ins, outs)
 
+    # prune nodes whose outputs never reach a graph output (e.g. the
+    # state heads a converter emits for a multi-output op whose states
+    # the symbol never consumed) — reverse sweep over the topo order
+    head_names = {value_names[(id(h), i)] for h, i in sym._entries}
+    needed = set(head_names)
+    kept: List[_pb.NodeProto] = []
+    for n2 in reversed(b.nodes):
+        if any(o in needed for o in n2.output):
+            kept.append(n2)
+            needed.update(i for i in n2.input if i)
+    b.nodes = kept[::-1]
+
     # lazily materialize constants (params + folded values) that emitted
     # nodes or graph outputs actually reference — folding intermediates
     # (e.g. the RNN packing chain) never hit the file
-    head_names = {value_names[(id(h), i)] for h, i in sym._entries}
     referenced = set(head_names)
     for n2 in b.nodes:
         referenced.update(n2.input)
+    # drop initializers that only pruned nodes consumed
+    b.initializers = [t for t in b.initializers if t.name in referenced]
     existing = {t.name for t in b.initializers}
     produced = {o for n2 in b.nodes for o in n2.output}
     bridge = {n for n in head_names

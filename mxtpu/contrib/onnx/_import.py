@@ -279,7 +279,16 @@ def _lrn(ctx, node, ins, at):
 
 @imports("Dropout")
 def _dropout(ctx, node, ins, at):
-    return ctx.sym.Dropout(ins[0], p=at.get("ratio", 0.5))
+    # opset ≥ 12 carries ratio as the optional second input (a constant
+    # scalar); older opsets use the attribute; default 0.5 per the spec.
+    # A PRESENT ratio input that is a runtime tensor must fail loudly —
+    # silently training the re-imported model at 0.5 would corrupt it.
+    if len(node.input) > 1 and node.input[1]:
+        p = float(_np.asarray(
+            ctx.const(node.input[1], "Dropout ratio")).reshape(()))
+    else:
+        p = at.get("ratio", 0.5)
+    return ctx.sym.Dropout(ins[0], p=p)
 
 
 @imports("Identity")

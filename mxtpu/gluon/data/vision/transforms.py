@@ -48,15 +48,25 @@ class ToTensor(HybridBlock):
 
 
 class Normalize(HybridBlock):
+    """Channel-wise (x - mean) / std. mean/std are Constant parameters
+    (initialized here, so no net.initialize() is needed): they reach
+    hybrid_forward through the F-agnostic parameter path, which keeps
+    the block trace-safe (mxlint MXL001) and ONNX-exportable — the old
+    body called ``nd.array`` on the hot path and broke every
+    hybridize()/export trace."""
+
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = mean
-        self._std = std
+        mean = _np.asarray(mean, "float32").reshape(-1, 1, 1)
+        std = _np.asarray(std, "float32").reshape(-1, 1, 1)
+        with self.name_scope():
+            self.mean = self.params.get_constant("mean", mean)
+            self.std = self.params.get_constant("std", std)
+        self.mean.initialize()
+        self.std.initialize()
 
-    def hybrid_forward(self, F, x):
-        mean = _np.asarray(self._mean, "float32").reshape(-1, 1, 1)
-        std = _np.asarray(self._std, "float32").reshape(-1, 1, 1)
-        return (x - nd.array(mean)) / nd.array(std)
+    def hybrid_forward(self, F, x, mean, std):
+        return (x - mean) / std
 
 
 def _resize_nd(x: NDArray, size) -> NDArray:

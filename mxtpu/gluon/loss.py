@@ -6,7 +6,6 @@ per-sample loss averaged over the batch axis per the reference's
 """
 from __future__ import annotations
 
-from .. import ndarray as nd
 from .block import HybridBlock
 
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
@@ -23,8 +22,11 @@ def _apply_weighting(F, loss, weight=None, sample_weight=None):
     return loss
 
 
-def _reshape_like(pred, label):
-    return label.reshape(pred.shape)
+def _reshape_like(F, pred, label):
+    # F.reshape_like, not label.reshape(pred.shape): Symbols have no
+    # .shape, so the attribute spelling breaks every hybridize()/export
+    # trace (mxlint MXL001's cousin — shape-dependent eager code)
+    return F.reshape_like(label, pred)
 
 
 class Loss(HybridBlock):
@@ -37,9 +39,10 @@ class Loss(HybridBlock):
         return (f"{self.__class__.__name__}(batch_axis={self._batch_axis}, "
                 f"w={self._weight})")
 
-    def _mean_all_but_batch(self, loss):
-        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
-        return loss.mean(axis=axes) if axes else loss
+    def _mean_all_but_batch(self, F, loss):
+        # exclude-mean (the reference's spelling): trace-safe — no
+        # .ndim read, the axis set resolves inside the op
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
 
 
 class L2Loss(Loss):
@@ -47,9 +50,9 @@ class L2Loss(Loss):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        loss = ((pred - _reshape_like(pred, label)) ** 2)
+        loss = ((pred - _reshape_like(F, pred, label)) ** 2)
         loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 class L1Loss(Loss):
@@ -57,9 +60,9 @@ class L1Loss(Loss):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        loss = (pred - _reshape_like(pred, label)).abs()
+        loss = (pred - _reshape_like(F, pred, label)).abs()
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
@@ -70,7 +73,7 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, sample_weight=None,
                        pos_weight=None):
-        label = _reshape_like(pred, label)
+        label = _reshape_like(F, pred, label)
         if not self._from_sigmoid:
             # log(1+exp(-|x|)) + max(x,0) - x*z  — numerically stable
             if pos_weight is None:
@@ -90,7 +93,7 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
                 loss = -((pred + eps).log() * label * pos_weight +
                          (1. - pred + eps).log() * (1. - label))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
@@ -113,10 +116,10 @@ class SoftmaxCrossEntropyLoss(Loss):
         if self._sparse_label:
             loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(pred, label)
+            label = _reshape_like(F, pred, label)
             loss = -(pred * label).sum(axis=self._axis, keepdims=True)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
@@ -134,7 +137,7 @@ class KLDivLoss(Loss):
             pred = F.log_softmax(pred, axis=self._axis)
         loss = label * ((label + 1e-12).log() - pred)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 class HuberLoss(Loss):
@@ -143,12 +146,14 @@ class HuberLoss(Loss):
         self._rho = rho
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        loss = (pred - _reshape_like(pred, label)).abs()
-        loss = nd.where((loss > self._rho).astype(loss.dtype),
-                        loss - 0.5 * self._rho,
-                        (0.5 / self._rho) * (loss ** 2))
+        loss = (pred - _reshape_like(F, pred, label)).abs()
+        # comparisons already return 0/1 in the operand dtype (both nd
+        # and sym), so no .astype(loss.dtype) — Symbols have no .dtype
+        loss = F.where(loss > self._rho,
+                       loss - 0.5 * self._rho,
+                       (0.5 / self._rho) * (loss ** 2))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 class HingeLoss(Loss):
@@ -157,9 +162,9 @@ class HingeLoss(Loss):
         self._margin = margin
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        loss = F.relu(self._margin - pred * _reshape_like(pred, label))
+        loss = F.relu(self._margin - pred * _reshape_like(F, pred, label))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 class SquaredHingeLoss(Loss):
@@ -168,9 +173,9 @@ class SquaredHingeLoss(Loss):
         self._margin = margin
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        loss = F.relu(self._margin - pred * _reshape_like(pred, label)) ** 2
+        loss = F.relu(self._margin - pred * _reshape_like(F, pred, label)) ** 2
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 class LogisticLoss(Loss):
@@ -182,13 +187,13 @@ class LogisticLoss(Loss):
         self._label_format = label_format
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(pred, label)
+        label = _reshape_like(F, pred, label)
         if self._label_format == "signed":
             label = (label + 1.0) / 2.0
         loss = F.relu(pred) - pred * label + \
             F.Activation(-pred.abs(), act_type="softrelu")
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return self._mean_all_but_batch(loss)
+        return self._mean_all_but_batch(F, loss)
 
 
 class TripletLoss(Loss):
@@ -198,10 +203,10 @@ class TripletLoss(Loss):
 
     def hybrid_forward(self, F, pred, positive, negative,
                        sample_weight=None):
-        positive = _reshape_like(pred, positive)
-        negative = _reshape_like(pred, negative)
-        loss = ((pred - positive) ** 2 - (pred - negative) ** 2) \
-            .sum(axis=tuple(range(1, pred.ndim))) + self._margin
+        positive = _reshape_like(F, pred, positive)
+        negative = _reshape_like(F, pred, negative)
+        loss = F.sum((pred - positive) ** 2 - (pred - negative) ** 2,
+                     axis=self._batch_axis, exclude=True) + self._margin
         loss = F.relu(loss)
         return _apply_weighting(F, loss, self._weight, sample_weight)
 
@@ -212,14 +217,16 @@ class CosineEmbeddingLoss(Loss):
         self._margin = margin
 
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
-        input1 = input1.reshape(input1.shape[0], -1)
-        input2 = input2.reshape(input2.shape[0], -1)
+        # MXNet reshape code 0 = keep that dim — no .shape read, so the
+        # flatten-to-(batch, -1) stays trace-safe
+        input1 = input1.reshape((0, -1))
+        input2 = input2.reshape((0, -1))
         cos = (input1 * input2).sum(axis=1) / \
             (input1.norm(axis=1) * input2.norm(axis=1) + 1e-12)
         label = label.reshape((-1,))
         pos = 1 - cos
         neg = F.relu(cos - self._margin)
-        loss = nd.where((label == 1).astype(cos.dtype), pos, neg)
+        loss = F.where(label == 1, pos, neg)
         return _apply_weighting(F, loss, self._weight, sample_weight)
 
 

@@ -156,7 +156,9 @@ class GluonLlama(HybridBlock):
         mesh = getattr(self, "_mesh", None)
         if labels is None:
             logits = _fl.forward(self._cfg, params, tok, mesh=mesh)
-            return NDArray(logits)
+            # GluonLlama is the bridge INTO the functional jax model —
+            # it jits through _call_cached_op, never Symbol-traces
+            return NDArray(logits)  # mxlint: disable=MXL001
         lab = labels._data if isinstance(labels, NDArray) else labels
         if lab.shape != tok.shape:
             raise ValueError(
@@ -164,7 +166,7 @@ class GluonLlama(HybridBlock):
                 f"sequence (got {lab.shape} vs {tok.shape}); the causal "
                 "shift is internal")
         loss = _fl.loss_fn(self._cfg, mesh)(params, {"tokens": tok})
-        return NDArray(loss)
+        return NDArray(loss)  # mxlint: disable=MXL001
 
     def generate(self, prompt, max_new_tokens: int, **kw):
         """KV-cache autoregressive generation (functional

@@ -235,6 +235,11 @@ class Constant(Parameter):
         self.value = value
 
         class _CInit(init_mod.Initializer):
+            def __call__(_self, _desc, arr):
+                # a Constant is a constant: bypass the name-suffix
+                # dispatch (which would zero a '*mean' or one a '*var')
+                arr[:] = value
+
             def _init_weight(_self, _name, arr):
                 arr[:] = value
 

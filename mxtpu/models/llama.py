@@ -231,7 +231,8 @@ def _attention(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
     if cfg.attn_impl in ("ring", "ulysses") and sp_ok:
         kernel = ring_attention if cfg.attn_impl == "ring" \
             else ulysses_attention
-        fn = jax.shard_map(
+        from ..parallel.compat import shard_map
+        fn = shard_map(
             partial(kernel, axis_name="sp", causal=True),
             mesh=mesh, in_specs=(_QKV, _QKV, _QKV), out_specs=_QKV,
             check_vma=False)

@@ -164,7 +164,9 @@ class Module(BaseModule):
 
     def update(self):
         assert self.optimizer_initialized
-        for i, name in enumerate(self._param_names):
+        # legacy Module API keeps the reference's per-param updater
+        # semantics; new code should use gluon Trainer.make_fused_step
+        for i, name in enumerate(self._param_names):  # mxlint: disable=MXL003
             grad = self._exec.grad_dict.get(name)
             if grad is None or self._exec.grad_req.get(name) == "null":
                 continue

@@ -1,0 +1,46 @@
+"""jax version-compatibility shims for the parallel subsystem.
+
+The container's jax (0.4.x) predates several APIs the codebase targets:
+``jax.shard_map`` (function, with ``check_vma``) lived at
+``jax.experimental.shard_map.shard_map`` (with ``check_rep``), and
+``jax.sharding.get_abstract_mesh`` did not exist. These shims present
+the NEW surface and translate down when needed, so call sites stay
+written against current jax.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "abstract_mesh_axes"]
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma=True, **kwargs):
+    """``jax.shard_map`` when available, else the experimental one with
+    ``check_vma`` translated to its old name ``check_rep``."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma, **kwargs)
+    from jax.experimental.shard_map import shard_map as _esm
+    return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=check_vma, **kwargs)
+
+
+def abstract_mesh_axes():
+    """(axis_names, auto_axis_names) of the ambient abstract mesh, or
+    ((), ()) when this jax has no abstract-mesh introspection (older
+    versions: code outside an explicit mesh context simply sees no
+    ambient mesh, which downgrades sharding constraints to no-ops)."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is None:
+        return (), ()
+    am = get()
+    names = tuple(am.axis_names)
+    try:
+        auto_t = jax.sharding.AxisType.Auto
+        auto = tuple(a for a, t in zip(names, am.axis_types)
+                     if t == auto_t)
+    except AttributeError:
+        auto = names
+    return names, auto

@@ -159,18 +159,13 @@ def constrain(x, *spec):
         pspec = _filter_spec(spec, mesh.axis_names)
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, pspec))
-    am = jax.sharding.get_abstract_mesh()
-    if not am.axis_names:          # no ambient mesh anywhere → no-op
+    from .compat import abstract_mesh_axes
+    names, auto = abstract_mesh_axes()
+    if not names:                  # no ambient mesh anywhere → no-op
         return x
     # inside shard_map, axes are Manual and constraints may only name
     # the remaining Auto axes (e.g. model code running under a gpipe
     # stage): constrain over those, or no-op when fully manual
-    try:
-        auto_t = jax.sharding.AxisType.Auto
-        auto = tuple(a for a, t in zip(am.axis_names, am.axis_types)
-                     if t == auto_t)
-    except AttributeError:
-        auto = tuple(am.axis_names)
     if not auto:
         return x
     return jax.lax.with_sharding_constraint(

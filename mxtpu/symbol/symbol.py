@@ -372,6 +372,16 @@ class Symbol:
             else:
                 var_structs[name] = jax.ShapeDtypeStruct(
                     tuple(spec), _np.float32)
+        return self._infer_structs_impl(var_structs)
+
+    def _infer_structs_impl(self, var_structs, on_error=None):
+        """The single inference walker, shared with the mxlint
+        graph-validity pass (mxtpu.contrib.analysis.graph — rule
+        MXL100). With ``on_error`` set, a failure is reported as
+        ``on_error(node, in_structs, exc, missing_var_name)`` (``exc``
+        None means the var named ``missing`` has no shape) and the walk
+        returns None instead of raising — one implementation, so the
+        MXL100 diagnostic cannot drift from the real inference path."""
         entry_structs: Dict[Tuple[int, int], jax.ShapeDtypeStruct] = {}
 
         def var_struct(node: _Node):
@@ -398,9 +408,17 @@ class Symbol:
                 if st is None and p.is_var():
                     st = var_struct(p)
                 if st is None:
+                    if on_error is not None:
+                        on_error(node, in_structs, None, p.name)
                     return None  # underdetermined
                 in_structs.append(st)
-            outs = _abstract_eval_node(node, in_structs)
+            try:
+                outs = _abstract_eval_node(node, in_structs)
+            except MXNetError as e:
+                if on_error is None:
+                    raise
+                on_error(node, in_structs, e, None)
+                return None
             for i, o in enumerate(outs):
                 entry_structs[(id(node), i)] = o
             if node.num_outputs is None:
@@ -408,8 +426,23 @@ class Symbol:
         # entries that are bare vars (identity outputs)
         for node, _ in self._entries:
             if node.is_var() and var_struct(node) is None:
+                if on_error is not None:
+                    on_error(node, [], None, node.name)
                 return None
         return entry_structs, var_structs
+
+    # -- static validation ---------------------------------------------------
+    def validate(self, params: Optional[Dict[str, Any]] = None,
+                 **input_shapes):
+        """Static graph-validity check (mxlint rule MXL100): run
+        shape/dtype inference node by node and return a list of
+        :class:`mxtpu.contrib.analysis.GraphIssue` — empty when the
+        graph is consistent. The first inconsistent node is reported
+        with its op name and inferred input shapes; the ONNX exporter
+        runs the same pass before conversion."""
+        from ..contrib.analysis.graph import validate_graph
+        return validate_graph(self, params=params,
+                              input_shapes=input_shapes)
 
     # -- serialization -------------------------------------------------------
     def tojson(self) -> str:

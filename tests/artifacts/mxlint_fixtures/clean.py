@@ -1,0 +1,44 @@
+"""Seeded mxlint fixture: trace-safe code full of near-misses — F-routed
+ops, math on python scalars, numpy in __init__, nd in the eager forward,
+static control flow. The linter must report NOTHING for this file
+(zero-false-positive guard). Never imported; AST only."""
+import math
+
+import numpy as np
+
+from mxtpu import ndarray as nd
+from mxtpu.gluon.block import HybridBlock
+
+SCALE = np.float32(2.0)  # module-level numpy: fine
+
+
+class CleanBlock(HybridBlock):
+    def __init__(self, channels):
+        super().__init__()
+        # numpy on config values at build time: fine
+        self._gain = float(np.sqrt(2.0 / channels))
+
+    def forward(self, x):
+        # eager-only path: nd is the correct backend here
+        return nd.relu(x) * self._gain
+
+    def hybrid_forward(self, F, x, gamma=None):
+        s = math.sqrt(2.0)  # math on python scalars: fine
+        if gamma is None:  # identity check: fine
+            scale = s
+        else:
+            scale = s * 0.5
+        if x.ndim == 3:  # static shape fact: fine
+            x = F.transpose(x, axes=(2, 0, 1))
+        out = [F.relu(x), F.tanh(x)]
+        return F.concat(*out, dim=-1) * scale
+
+
+class CleanTrainer:
+    def __init__(self, params):
+        self._params = params
+
+    def update(self, metric, labels, preds):
+        # metric-style update loop, no optimizer dispatch: fine
+        for label, pred in zip(labels, preds):
+            metric.append((label - pred) ** 2)

@@ -1,0 +1,32 @@
+"""Seeded mxlint fixture: MXL003 dispatch-count violations — the
+~150-dispatches-per-step patterns the fused step exists to kill:
+per-parameter updater calls inside step()/update(), and the
+user-script shape that set_data()s every parameter from its grad.
+Never imported; AST only."""
+from mxtpu.ndarray import sgd_update
+
+
+class EagerTrainer:
+    def __init__(self, params, updater):
+        self._params = params
+        self._updater = updater
+
+    def update(self, batch_size):
+        for i, p in enumerate(self._params):  # seeded: MXL003
+            sgd_update(p.data(), p.grad(), lr=0.1 / batch_size)
+
+    def step(self, batch_size):
+        for i, p in enumerate(self._params):  # seeded: MXL003
+            self._updater(i, p.grad(), p.data())
+
+    def zero(self):
+        for p in self._params:  # not a dispatch loop: no finding
+            p.zero_grad()
+
+
+def train_epoch(net, batches, lr):
+    for x, y in batches:  # data loop: no finding
+        loss = net(x)
+        loss.backward()
+        for p in net.collect_params().values():  # seeded: MXL003
+            p.set_data(p.data() - lr * p.grad())

@@ -1,0 +1,33 @@
+"""Seeded mxlint fixture: faithful reproduction of the round-5
+HybridConcatenate regression — ``hybrid_forward`` hardcodes
+``nd.concat`` instead of routing through ``F``, which killed every
+hybridize()/export trace of the inception/squeezenet/mobilenet
+families. The linter must flag it (MXL001).
+
+``# seeded: <ID>`` markers name the expected finding on that line;
+tests/test_mxlint.py asserts the findings match the markers EXACTLY
+(100% flagged, zero false positives). This file is never imported.
+"""
+from mxtpu import ndarray as nd
+from mxtpu.gluon.block import HybridBlock
+
+
+class HybridConcatenate(HybridBlock):
+    """Run children on the same input and concat outputs."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        # eager path: nd here is correct and must NOT be flagged
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)  # seeded: MXL001

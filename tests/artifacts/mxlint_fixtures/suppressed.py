@@ -1,0 +1,28 @@
+"""Seeded mxlint fixture: every violation here carries a
+``# mxlint: disable=<ID>`` suppression (same-line and standalone
+preceding-line forms) — the linter must report NOTHING for this file.
+Never imported; AST only."""
+from mxtpu import ndarray as nd
+from mxtpu.gluon.block import HybridBlock
+
+
+class Suppressed(HybridBlock):
+    def hybrid_forward(self, F, x):
+        y = nd.relu(x)  # mxlint: disable=MXL001
+        # mxlint: disable=MXL002
+        if x.sum() > 0:
+            y = y * 2
+        if y.mean() > 0:  # mxlint: disable=all
+            y = y + 1
+        return y
+
+
+class EagerTrainer:
+    def __init__(self, params, updater):
+        self._params = params
+        self._updater = updater
+
+    def update(self, batch_size):
+        # mxlint: disable=MXL003
+        for i, p in enumerate(self._params):
+            self._updater(i, p.grad(), p.data())

@@ -1,0 +1,31 @@
+"""Seeded mxlint fixture: MXL001 trace-safety violations across the
+import-alias spellings the rule must resolve — plain numpy, jax.numpy,
+mxtpu.ndarray (module alias and from-import), and an ``mx.nd.*``
+package-attribute chain. Never imported; AST only."""
+import numpy as np
+import jax.numpy as jnp
+import mxtpu as mx
+from mxtpu import ndarray as nd
+from mxtpu.ndarray import concat as nd_concat
+from mxtpu.gluon.block import HybridBlock
+
+
+def np_at_module_level_is_fine():
+    return np.zeros((2, 2))  # not inside hybrid_forward: no finding
+
+
+class Bad(HybridBlock):
+    def hybrid_forward(self, F, x, y):
+        a = np.maximum(x, 0.0)  # seeded: MXL001
+        b = jnp.concatenate([x, y], axis=-1)  # seeded: MXL001
+        c = nd.concat(x, y, dim=1)  # seeded: MXL001
+        d = nd_concat(x, y, dim=1)  # seeded: MXL001
+        e = mx.nd.relu(x)  # seeded: MXL001
+        return a + b + c + d + e
+
+
+class StillBadInNestedHelper(HybridBlock):
+    def hybrid_forward(self, F, x):
+        def helper(v):
+            return nd.relu(v)  # seeded: MXL001
+        return helper(x)

@@ -1,0 +1,36 @@
+"""Seeded mxlint fixture: MXL002 tracer-control-flow violations —
+Python ``if``/``while``/``assert`` on values derived from
+hybrid_forward tensor arguments — interleaved with the static patterns
+that must NOT be flagged (shape facts, identity checks, config
+attributes). Never imported; AST only."""
+from mxtpu.gluon.block import HybridBlock
+
+
+class Flow(HybridBlock):
+    def __init__(self, act=True):
+        super().__init__()
+        self._act = act
+
+    def hybrid_forward(self, F, x, bias=None):
+        if x.sum() > 0:  # seeded: MXL002
+            x = x * 2
+        y = x + 1
+        while y.max() < 10:  # seeded: MXL002
+            y = y * 2
+        assert (y > 0).sum() > 0  # seeded: MXL002
+        if y:  # seeded: MXL002
+            y = y + 1
+        if bias is not None:  # identity check: static, no finding
+            y = y + bias
+        if self._act:  # config attribute: static, no finding
+            y = F.relu(y)
+        if x.shape[0] > 1:  # shape fact: static, no finding
+            y = y + 1
+        if len(x.shape) == 2 and x.ndim == 2:  # static, no finding
+            y = y * 2
+        if isinstance(bias, float):  # static, no finding
+            y = y + bias
+        scale = 2.0
+        if scale > 1.0:  # plain python value: no finding
+            y = y * scale
+        return y

@@ -84,6 +84,23 @@ def test_transforms():
     assert comp(img).shape == (3, 28, 28)
 
 
+def test_normalize_is_trace_safe():
+    """mxlint MXL001 regression: Normalize used to call nd.array inside
+    hybrid_forward, which broke every symbolic trace. mean/std are now
+    Constant parameters, so the block traces and the normalization
+    matches the eager path numerically."""
+    import mxtpu.symbol as sym
+    net = transforms.Normalize(mean=(0.5, 0.4, 0.3), std=(0.5, 0.5, 0.5))
+    x = mx.nd.array(np.random.RandomState(0).rand(3, 8, 8)
+                    .astype(np.float32))
+    ref = ((x.asnumpy() -
+            np.array([0.5, 0.4, 0.3], np.float32).reshape(-1, 1, 1)) /
+           np.array([0.5, 0.5, 0.5], np.float32).reshape(-1, 1, 1))
+    np.testing.assert_allclose(net(x).asnumpy(), ref, atol=1e-6)
+    out = net._trace_symbol(sym.var("data"))  # used to raise
+    assert set(out.list_inputs()) >= {"data"}
+
+
 @with_seed()
 def test_dataloader_with_transform():
     ds = MNIST(train=True, synthetic=True, synthetic_size=32) \

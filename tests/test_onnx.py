@@ -573,6 +573,94 @@ def test_model_zoo_family_onnx_roundtrip(name, tmp_path):
     np.testing.assert_allclose(ref, got, atol=1e-4, rtol=1e-4)
 
 
+def test_import_dropout_ratio_input_opset12(tmp_path):
+    """opset ≥ 12 carries Dropout ratio as the optional second input;
+    the importer must read it from there (constant), fall back to the
+    attribute, then to 0.5."""
+    pb, m = _base_model()
+    _add_input(m, "x", (2, 3))
+    r = m.graph.initializer.add(name="r", data_type=pb.TensorProto.FLOAT,
+                                dims=[])
+    r.raw_data = np.asarray(0.25, np.float32).tobytes()
+    m.graph.node.add(op_type="Dropout", input=["x", "r"], output=["y"],
+                     name="d0")
+    m.graph.output.add().name = "y"
+    sym2, _, _ = onnx_mxtpu.import_model(_load(m, tmp_path))
+    (node,) = [n for n in sym2._topo() if n.op == "Dropout"]
+    assert node.attrs["p"] == 0.25
+    # no ratio input → attribute wins; neither → 0.5 default
+    m2 = _base_model()[1]
+    _add_input(m2, "x", (2, 3))
+    n = m2.graph.node.add(op_type="Dropout", input=["x"], output=["y"],
+                          name="d0")
+    a = n.attribute.add()
+    a.name = "ratio"
+    a.type = pb.AttributeProto.FLOAT
+    a.f = 0.125
+    m2.graph.output.add().name = "y"
+    sym3, _, _ = onnx_mxtpu.import_model(_load(m2, tmp_path, "attr.onnx"))
+    (node3,) = [n_ for n_ in sym3._topo() if n_.op == "Dropout"]
+    assert abs(node3.attrs["p"] - 0.125) < 1e-7
+    # a PRESENT ratio input that is a runtime tensor must fail loudly,
+    # not silently re-train at 0.5
+    m3 = _base_model()[1]
+    _add_input(m3, "x", (2, 3))
+    _add_input(m3, "r", ())
+    m3.graph.node.add(op_type="Dropout", input=["x", "r"], output=["y"],
+                      name="d0")
+    m3.graph.output.add().name = "y"
+    with pytest.raises(ValueError, match="Dropout ratio"):
+        onnx_mxtpu.import_model(_load(m3, tmp_path, "rt.onnx"))
+
+
+def test_export_model_multi_input_needs_shapes(tmp_path):
+    """A HybridBlock whose forward takes two inputs, exported without
+    input_shapes, must raise a ValueError asking for input_shapes — not
+    the confusing single-'data' arity TypeError."""
+    from mxtpu import gluon
+
+    class TwoInput(gluon.HybridBlock):
+        def hybrid_forward(self, F, a, b):
+            return a + b
+
+    net = TwoInput()
+    net.initialize()
+    with pytest.raises(ValueError, match="input_shapes"):
+        onnx_mxtpu.export_model(net,
+                                onnx_file=str(tmp_path / "two.onnx"))
+    # with shapes for both inputs it exports fine
+    path = onnx_mxtpu.export_model(
+        net, input_shapes=[(2, 3), (2, 3)],
+        onnx_file=str(tmp_path / "two_ok.onnx"))
+    block = onnx_mxtpu.import_to_gluon(path)
+    x = np.random.RandomState(3).rand(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        block(nd.array(x), nd.array(x)).asnumpy(), x + x, atol=1e-6)
+
+
+def test_lstm_export_folds_param_packing(tmp_path):
+    """gluon LSTM → ONNX: the cuDNN parameter-packing chain (per-gate
+    reshape/concat of the weights) must constant-fold so the RNN
+    converter sees one packed vector; the exported file carries LSTM
+    nodes and no leftover packing Reshape/Concat of initializers."""
+    from mxtpu.gluon import rnn as grnn
+    net = grnn.LSTM(hidden_size=8, num_layers=2, layout="NTC")
+    net.initialize()
+    x = nd.array(np.random.RandomState(17).rand(2, 5, 4)
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "lstm.onnx")
+    onnx_mxtpu.export_model(net, input_shapes=[(2, 5, 4)],
+                            onnx_file=path)
+    model = onnx_mxtpu.onnx_pb2.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    ops = [n.op_type for n in model.graph.node]
+    assert ops.count("LSTM") == 2  # one fused node per layer
+    assert "Concat" not in ops  # the packing chain folded away
+    assert ref.shape == (2, 5, 8)
+
+
 def test_batchnorm_fix_gamma_roundtrip(tmp_path):
     """fix_gamma pins gamma to 1 via a FRESH initializer (the stored
     gamma value must be ignored, and other consumers unaffected)."""

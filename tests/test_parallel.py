@@ -7,7 +7,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax: experimental location
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mxtpu import parallel as par

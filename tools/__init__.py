@@ -1,0 +1,3 @@
+"""tools/ as a package so ``python -m tools.mxlint`` works from the
+repo root. The individual scripts (launch.py, im2rec.py, ...) are still
+run by path, unchanged."""

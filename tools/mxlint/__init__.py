@@ -1,0 +1,84 @@
+"""mxlint — trace-safety and graph-validity static analysis for mxtpu.
+
+CLI front end over :mod:`mxtpu.contrib.analysis`. The AST rule engine
+(``rules.py``) is stdlib-only, so it is loaded directly by file path —
+``python -m tools.mxlint`` lints without importing mxtpu (and therefore
+without importing jax), which keeps the CI stage and editor loops fast.
+The graph pass (``MXL100``) does need the runtime; use
+``mxtpu.contrib.analysis.validate_graph`` / ``Symbol.validate`` for it.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+_RULES_PATH = os.path.join(_ROOT, "mxtpu", "contrib", "analysis",
+                           "rules.py")
+
+
+def _load_rules():
+    spec = importlib.util.spec_from_file_location("_mxlint_rules",
+                                                  _RULES_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+rules = _load_rules()
+RULES = rules.RULES
+Finding = rules.Finding
+lint_source = rules.lint_source
+lint_file = rules.lint_file
+lint_paths = rules.lint_paths
+iter_python_files = rules.iter_python_files
+
+__all__ = ["RULES", "Finding", "lint_source", "lint_file", "lint_paths",
+           "iter_python_files", "main"]
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json as _json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="mxlint: trace-safety static analysis for mxtpu "
+                    "(rules MXL001-MXL003; see docs/lint.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint (default: "
+                         "mxtpu/ example/ relative to the repo root)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--rules", metavar="ID[,ID...]",
+                    help="only run these rule IDs")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid]}")
+        return 0
+
+    paths = args.paths or [os.path.join(_ROOT, "mxtpu"),
+                           os.path.join(_ROOT, "example")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"mxlint: no such path: {p}")
+            return 2
+    only = args.rules.split(",") if args.rules else None
+    findings = lint_paths(paths, rules=only)
+    if args.json:
+        print(_json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        n_files = sum(1 for _ in iter_python_files(paths))
+        status = "clean" if not findings else \
+            f"{len(findings)} finding(s)"
+        print(f"mxlint: {n_files} file(s), {status}")
+    return 1 if findings else 0
