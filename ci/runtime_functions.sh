@@ -57,6 +57,15 @@ dist_tests() {
     python -m pytest tests/test_tools.py -x -q "$@"
 }
 
+fault_tolerance() {
+    # the chaos suite (docs/robustness.md): seeded fault injection
+    # against the distributed stack, then tools/flakiness_checker.py
+    # reruns the WHOLE file over random seeds to prove the chaos is
+    # deterministic (a flaky fault-tolerance test is worse than none)
+    python -m pytest tests/test_fault_tolerance.py -x -q "$@"
+    python tools/flakiness_checker.py tests/test_fault_tolerance.py -n 3
+}
+
 multichip_dryrun() {
     # what the driver runs: self-provisioning 8-device sharded step
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -148,6 +157,7 @@ ci_all() {
     sanity_check
     mxlint
     unittest_cpu_mesh
+    fault_tolerance
     multichip_dryrun
     bench_smoke
     opperf_coverage

@@ -22,7 +22,9 @@ __all__ = [
     "env_int",
     "env_bool",
     "env_str",
+    "env_float",
     "registered_env_vars",
+    "atomic_write",
 ]
 
 
@@ -100,6 +102,40 @@ def env_bool(name: str, default: bool, doc: str = "") -> bool:
 def env_str(name: str, default: str, doc: str = "") -> str:
     _register(name, default, doc)
     return os.environ.get(name, default)
+
+
+def env_float(name: str, default: float, doc: str = "") -> float:
+    _register(name, default, doc)
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically: tempfile in the same
+    directory + fsync + ``os.replace``, so a mid-write kill (OOM,
+    preemption, SIGKILL) leaves either the complete old file or the
+    complete new one on disk — never a torn mix. The ONE durable-write
+    helper: Trainer.save_states and the kvstore server's crash-recovery
+    snapshot both go through it."""
+    import tempfile
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def registered_env_vars() -> Dict[str, Dict[str, Any]]:

@@ -7,15 +7,26 @@ callbacks + ``fit(begin_epoch=k)``; elastic recovery did not exist).
   ``parallel.step.TrainState`` NamedTuple included): sharded arrays save
   per-shard (tensorstore/ocdbt), restore respects the live mesh, async
   mode overlaps the write with the next steps.
+- :class:`PreemptionGuard` turns SIGTERM/SIGINT (the cluster
+  scheduler's preemption notice) into a cooperative flag the training
+  loop checks at step boundaries, then forces ONE final synchronous
+  save — the in-flight async write is waited out first, so a preempted
+  job never loses its tail steps (docs/robustness.md).
+- ``restore()`` falls back to the previous retained step when the
+  latest checkpoint is partial/corrupt (a kill can tear a step
+  directory faster than orbax's commit protocol can clean it up).
 - The ``.params`` compatibility surface stays in mxtpu.serde /
   Block.save_parameters; this module is the functional-path manager.
 """
 from __future__ import annotations
 
 import os
+import signal as _signal
+import warnings
 from typing import Any, Optional
 
-__all__ = ["CheckpointManager", "save_state", "load_state"]
+__all__ = ["CheckpointManager", "PreemptionGuard", "save_state",
+           "load_state"]
 
 
 class CheckpointManager:
@@ -35,23 +46,53 @@ class CheckpointManager:
             enable_async_checkpointing=async_save)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
-    def save(self, step: int, state: Any) -> bool:
-        """Save a pytree at ``step`` (no-op off the save interval).
-        Async mode returns immediately; the write completes in the
-        background (call wait_until_finished() before exiting)."""
-        return self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Save a pytree at ``step`` (no-op off the save interval
+        unless ``force``). Async mode returns immediately; the write
+        completes in the background (call wait_until_finished() before
+        exiting). ``force=True`` ignores the save interval — the
+        preemption final-save path."""
+        return self._mgr.save(step, args=self._ocp.args.StandardSave(state),
+                              force=force)
 
     def restore(self, step: Optional[int] = None,
-                abstract_state: Any = None) -> Any:
+                abstract_state: Any = None, fallback: bool = True) -> Any:
         """Restore the given (default: latest) step. Pass
         ``abstract_state`` (a pytree of like-structured values or
         ShapeDtypeStructs, e.g. a freshly-initialized TrainState) to
-        restore with matching structure/sharding."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        restore with matching structure/sharding.
+
+        When restoring the LATEST step and it turns out partial or
+        corrupt (torn by a kill mid-write), fall back to the previous
+        retained step instead of failing the relaunch — checkpoint
+        +restart must survive exactly the crashes it exists for. An
+        EXPLICITLY requested step never falls back: the caller asked
+        for that step, silently returning another would be worse.
+        ``fallback=False`` disables the scan entirely."""
+        if step is not None:
+            return self._restore_one(step, abstract_state)
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
+        last_err: Optional[BaseException] = None
+        for s in candidates:
+            try:
+                return self._restore_one(s, abstract_state)
+            except Exception as e:
+                last_err = e
+                if not fallback:
+                    raise
+                warnings.warn(
+                    f"checkpoint step {s} under {self.directory} is "
+                    f"partial/corrupt ({type(e).__name__}: {e}); "
+                    "falling back to the previous retained step",
+                    RuntimeWarning)
+        raise RuntimeError(
+            f"every retained checkpoint under {self.directory} failed "
+            f"to restore (steps {candidates})") from last_err
+
+    def _restore_one(self, step: int, abstract_state: Any) -> Any:
         if abstract_state is not None:
             return self._mgr.restore(
                 step, args=self._ocp.args.StandardRestore(abstract_state))
@@ -68,6 +109,73 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+class PreemptionGuard:
+    """Preemption-safe shutdown: catch SIGTERM/SIGINT and convert them
+    into a flag the training loop checks at step boundaries, plus a
+    forced final SYNCHRONOUS save.
+
+    Usage::
+
+        mgr = CheckpointManager(ckdir, async_save=True)
+        with PreemptionGuard(mgr) as guard:
+            for i in range(start, steps):
+                state, loss = train_step(state, batch)
+                mgr.save(i, state)
+                if guard.preempted:
+                    guard.save_now(i, state)   # sync, ignores interval
+                    break
+        # relaunch: CheckpointManager(ckdir).restore(...) resumes at i
+
+    Coordination: a pod scheduler signals EVERY process of the job, so
+    each rank observes ``preempted`` and reaches the same ``save_now``
+    step boundary — orbax's multi-process commit protocol then makes
+    the final save atomic across ranks. A second signal while the
+    final save is running is left to the default disposition only
+    after ``__exit__`` restores handlers; inside the guard it just
+    re-sets the flag (the save must not be torn by a double-SIGTERM).
+    """
+
+    def __init__(self, manager: Optional[CheckpointManager] = None,
+                 signals=(_signal.SIGTERM, _signal.SIGINT)):
+        self._manager = manager
+        self._signals = tuple(signals)
+        self._old: dict = {}
+        self.preempted = False
+        self.signum: Optional[int] = None
+
+    def _handler(self, signum, frame):
+        self.preempted = True
+        self.signum = signum
+
+    def __enter__(self) -> "PreemptionGuard":
+        for s in self._signals:
+            self._old[s] = _signal.signal(s, self._handler)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        for s, h in self._old.items():
+            _signal.signal(s, h)
+        self._old.clear()
+        return False
+
+    def save_now(self, step: int, state: Any) -> None:
+        """The final save: wait out any in-flight ASYNC write (orbax
+        would abandon it on process exit), then force-save this step
+        synchronously, ignoring the save interval."""
+        if self._manager is None:
+            raise ValueError(
+                "PreemptionGuard(manager=...) is required for save_now")
+        self._manager.wait_until_finished()
+        try:
+            self._manager.save(step, state, force=True)
+        except Exception as e:
+            # the interval save already committed this exact step —
+            # nothing left to persist (orbax StepAlreadyExistsError)
+            if type(e).__name__ != "StepAlreadyExistsError":
+                raise
+        self._manager.wait_until_finished()
 
 
 def save_state(path: str, state: Any) -> None:

@@ -27,4 +27,6 @@ def __getattr__(name):
         return importlib.import_module("mxtpu.contrib.onnx")
     if name == "analysis":
         return importlib.import_module("mxtpu.contrib.analysis")
+    if name == "chaos":
+        return importlib.import_module("mxtpu.contrib.chaos")
     raise AttributeError(f"module 'mxtpu.contrib' has no attribute {name!r}")

@@ -1,0 +1,342 @@
+"""mx.contrib.chaos — deterministic fault injection for the
+distributed stack (docs/robustness.md).
+
+The reference's recovery story was only ever TESTED by hand (SURVEY
+§5.3: checkpoint+restart); this module is the missing verification
+depth — seeded, reproducible faults driven by tier-1 tests
+(tests/test_fault_tolerance.py):
+
+- :class:`ChaosPlan` — a seeded schedule of dropped / duplicated /
+  delayed PS messages, attached to a ``ServerClient`` via
+  :func:`attach`. "drop_before_send" kills the connection before the
+  request leaves (the request is LOST — retry must re-apply);
+  "drop_after_send" kills it after the request is on the wire but
+  before the ack returns (the request is APPLIED — retry is a
+  duplicate delivery the server must dedup). Together they cover both
+  halves of the at-most-once/at-least-once ambiguity that makes naive
+  retry wrong.
+- :class:`ServerProcess` — a standalone parameter server in a child
+  process (``python -m mxtpu.kvstore.server``) that tests can
+  ``kill()`` (SIGKILL, mid-epoch) and ``restart()`` against the same
+  snapshot path.
+- :class:`VirtualAllreduceKV` — an in-process N-rank lockstep cluster
+  (threads + a real barrier-synchronized allreduce) for exercising
+  cross-rank agreement paths (``Trainer._all_workers_finite``) without
+  N processes.
+- :func:`poison_nan` — NaN-poison a parameter's gradient (the AMP
+  global-overflow-skip scenario).
+- :func:`simulate_preemption` — deliver SIGTERM to this process, the
+  scheduler-preemption notice ``checkpoint.PreemptionGuard`` absorbs.
+
+Everything is seeded and thread-free on the decision path, so a chaos
+run is exactly reproducible — ci/runtime_functions.sh proves it by
+rerunning the suite under tools/flakiness_checker.py.
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ChaosPlan", "attach", "ServerProcess", "VirtualAllreduceKV",
+           "poison_nan", "simulate_preemption"]
+
+
+class ChaosPlan:
+    """Seeded fault schedule for PS client requests.
+
+    Faults come from an explicit ``schedule`` (request index → action)
+    and/or seeded per-request probabilities. Actions:
+
+    - ``"drop_before_send"``: close the socket, raise — the request
+      never reaches the server (a lost message).
+    - ``"drop_after_send"``: let the request go out, then close the
+      socket before the reply is read — the server applied it but the
+      worker doesn't know (a lost ack → the retry is a duplicate
+      delivery).
+    - ``"delay"``: sleep ``delay_s`` before sending (reordering
+      pressure on the heartbeat/timeout machinery).
+
+    ``injected`` counts what actually fired, for test assertions."""
+
+    ACTIONS = ("drop_before_send", "drop_after_send", "delay")
+
+    def __init__(self, seed: int = 0,
+                 schedule: Optional[Dict[int, str]] = None,
+                 drop_before_send: float = 0.0,
+                 drop_after_send: float = 0.0,
+                 delay: float = 0.0, delay_s: float = 0.02,
+                 max_faults: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._schedule = dict(schedule or {})
+        for a in self._schedule.values():
+            if a not in self.ACTIONS:
+                raise ValueError(f"unknown chaos action {a!r}")
+        self._p = {"drop_before_send": drop_before_send,
+                   "drop_after_send": drop_after_send,
+                   "delay": delay}
+        self._delay_s = delay_s
+        self._max_faults = max_faults
+        self.requests = 0           # request attempts seen (incl. retries)
+        self._req_index = 0         # fresh requests (retries not counted)
+        self._pending_after: bool = False
+        self.injected: Dict[str, int] = {a: 0 for a in self.ACTIONS}
+
+    @property
+    def total_injected(self) -> int:
+        return sum(self.injected.values())
+
+    def _decide(self) -> Optional[str]:
+        if self._max_faults is not None and \
+                self.total_injected >= self._max_faults:
+            return None
+        if self._req_index in self._schedule:
+            return self._schedule[self._req_index]
+        for action in self.ACTIONS:
+            p = self._p[action]
+            if p > 0.0 and self._rng.random() < p:
+                return action
+        return None
+
+    # -- ServerClient hooks -------------------------------------------------
+    def on_request(self, client) -> None:
+        """Called with the client's lock held, before the frame is
+        sent. Retries re-enter here: only the FIRST attempt of each
+        request consumes a schedule slot, so a fault schedule indexes
+        logical requests, not wire attempts."""
+        self.requests += 1
+        action = None
+        if not getattr(client, "_chaos_retrying", False):
+            action = self._decide()
+            self._req_index += 1
+        client._chaos_retrying = True   # cleared by on_sent
+        self._pending_after = action == "drop_after_send"
+        if action == "drop_before_send":
+            self.injected[action] += 1
+            client._drop_socket()
+            raise ConnectionError("chaos: injected drop before send")
+        if action == "delay":
+            self.injected[action] += 1
+            time.sleep(self._delay_s)
+
+    def on_sent(self, client) -> None:
+        """Called after the frame hit the wire, before the reply is
+        read. The retry flag is NOT cleared here — a real recv failure
+        after a clean send (server killed mid-reply) still makes the
+        next attempt a retry of the same logical request, so it must
+        not consume a fresh schedule slot; the client resets the flag
+        when a NEW envelope starts (ServerClient._roundtrip)."""
+        if self._pending_after:
+            self._pending_after = False
+            self.injected["drop_after_send"] += 1
+            # give the server a beat to consume the frame before the
+            # teardown races it (localhost: it is already in its
+            # buffer; the sleep only derisks scheduling)
+            time.sleep(0.05)
+            client._drop_socket()
+            raise ConnectionError("chaos: injected drop after send")
+
+
+def attach(client_or_kvstore, plan: ChaosPlan) -> ChaosPlan:
+    """Wire a ChaosPlan into a ``ServerClient`` (or an
+    ``AsyncDistKVStore``, whose ``_client`` is used)."""
+    client = getattr(client_or_kvstore, "_client", client_or_kvstore)
+    client.chaos = plan
+    client._chaos_retrying = False
+    return plan
+
+
+class ServerProcess:
+    """A standalone parameter server in a child process, with
+    kill()/restart() for crash-recovery tests.
+
+    The child runs ``python -m mxtpu.kvstore.server`` with a snapshot
+    path, so SIGKILL + ``restart()`` exercises the real recovery path:
+    snapshot reload + client retry + seq dedup."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_every: int = 1,
+                 env: Optional[dict] = None, start_timeout: float = 90.0):
+        if port == 0:
+            port = free_port(host)
+        self.host, self.port = host, port
+        self.snapshot_path = snapshot_path
+        self._snapshot_every = snapshot_every
+        self._env = {**os.environ, **(env or {})}
+        # the child must never grab the accelerator: it is a numpy
+        # host-side store
+        self._env.setdefault("JAX_PLATFORMS", "cpu")
+        self._start_timeout = start_timeout
+        self.proc: Optional[subprocess.Popen] = None
+        self.start()
+
+    def _cmd(self) -> List[str]:
+        cmd = [sys.executable, "-m", "mxtpu.kvstore.server",
+               "--host", self.host, "--port", str(self.port)]
+        if self.snapshot_path:
+            cmd += ["--snapshot-path", self.snapshot_path,
+                    "--snapshot-every", str(self._snapshot_every)]
+        return cmd
+
+    def start(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            return
+        self.proc = subprocess.Popen(
+            self._cmd(), env=self._env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.wait_ready()
+
+    def wait_ready(self) -> None:
+        """Block until the child answers a heartbeat ping."""
+        from ..kvstore.server import ServerClient
+        deadline = time.monotonic() + self._start_timeout
+        while True:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"chaos server exited rc={self.proc.returncode} "
+                    "before becoming ready")
+            try:
+                cl = ServerClient(self.host, self.port, timeout=2.0)
+                try:
+                    cl.ping(timeout=2.0)
+                finally:
+                    cl.close()
+                return
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+
+    def kill(self) -> None:
+        """SIGKILL — the unclean mid-epoch crash. No snapshot flush, no
+        goodbye: recovery rides whatever already hit the disk."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+    def restart(self) -> None:
+        self.kill()
+        self.start()
+
+    def stop(self) -> None:
+        """Graceful SIGTERM (flushes a final snapshot) with a SIGKILL
+        fallback."""
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+    def __enter__(self) -> "ServerProcess":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (best-effort: released before use,
+    like every test-harness port picker)."""
+    import socket as _socket
+    s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class VirtualAllreduceKV:
+    """An in-process N-rank cluster whose ``_allreduce`` is a REAL
+    barrier-synchronized sum across N rank threads — the cheapest
+    honest way to exercise cross-rank agreement logic
+    (``Trainer._all_workers_finite``) on one host.
+
+    Each rank thread registers itself via ``run(fn)``; inside ``fn``,
+    any Trainer handed this object as its kvstore participates in
+    lockstep allreduces with the other ranks. Deadlocks by design if
+    ranks disagree on how many collectives they issue — which is
+    exactly the divergence bug the global-skip path exists to
+    prevent."""
+
+    def __init__(self, num_workers: int):
+        self.num_workers = num_workers
+        self._barrier = threading.Barrier(num_workers)
+        self._contrib: List = [None] * num_workers
+        self._result = None
+        self._tls = threading.local()
+
+    # Trainer probes these
+    @property
+    def rank(self) -> int:
+        return getattr(self._tls, "rank", 0)
+
+    def _allreduce(self, value):
+        """SUM ``value`` (an NDArray) across all rank threads."""
+        import numpy as onp
+        from .. import ndarray as nd
+        rank = self._tls.rank
+        self._contrib[rank] = onp.asarray(value.asnumpy())
+        if self._barrier.wait() == 0:          # all deposited
+            self._result = sum(self._contrib)
+        self._barrier.wait()                   # result published
+        # safe to read until every rank re-enters the next allreduce's
+        # first barrier — which requires every rank to have read
+        return nd.array(self._result)
+
+    def run(self, fn: Callable[[int], None], timeout: float = 120.0):
+        """Run ``fn(rank)`` on ``num_workers`` threads in lockstep;
+        re-raise the first rank's exception."""
+        errors: List = [None] * self.num_workers
+
+        def _runner(rank):
+            self._tls.rank = rank
+            try:
+                fn(rank)
+            except BaseException as e:   # noqa: BLE001 — reported below
+                errors[rank] = e
+                self._barrier.abort()    # release peers blocked on us
+
+        threads = [threading.Thread(target=_runner, args=(r,), daemon=True)
+                   for r in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout)
+            if t.is_alive():
+                self._barrier.abort()
+                raise TimeoutError(
+                    "virtual cluster rank hung (collective mismatch?)")
+        real = [e for e in errors
+                if e is not None
+                and not isinstance(e, threading.BrokenBarrierError)]
+        if real:
+            raise real[0]
+        broken = [e for e in errors if e is not None]
+        if broken:                      # every error was a barrier break
+            raise broken[0]             # with no root cause recorded
+        return None
+
+
+def poison_nan(param) -> None:
+    """Overwrite a parameter's gradient with NaNs — the poisoned-rank
+    half of the AMP global-overflow scenario."""
+    import jax.numpy as jnp
+    g = param.grad()
+    g._set_data(jnp.full(g.shape, jnp.nan, dtype=g._data.dtype))
+
+
+def simulate_preemption(sig: int = signal.SIGTERM) -> None:
+    """Deliver the scheduler's preemption notice to THIS process (the
+    signal ``checkpoint.PreemptionGuard`` absorbs)."""
+    os.kill(os.getpid(), sig)

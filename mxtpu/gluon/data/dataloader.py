@@ -199,6 +199,49 @@ class DataLoader:
             self._pool_cache = pool
         return pool
 
+    def _track_workers(self) -> None:
+        """Remember every worker Process the pool has ever run: the
+        pool's maintenance thread reaps+replaces dead workers, so by
+        the time a timeout fires the corpse may be gone from
+        ``pool._pool`` — but the Process objects keep their exitcode."""
+        reg = getattr(self, "_worker_registry", None)
+        if reg is None:
+            reg = self._worker_registry = {}
+        pool = getattr(self, "_pool_cache", None)
+        if pool is not None:
+            for p in list(getattr(pool, "_pool", [])):
+                reg[p.pid] = p
+
+    def _dead_worker_report(self) -> str:
+        self._track_workers()
+        purged = getattr(self, "_purged_pids", set())
+        dead = sorted(
+            (pid, p.exitcode)
+            for pid, p in getattr(self, "_worker_registry", {}).items()
+            if p.exitcode not in (None, 0) and pid not in purged)
+        if not dead:
+            return "no worker exited abnormally (stuck, not dead?)"
+        return "dead worker exit codes: " + ", ".join(
+            f"pid {pid} -> {code}" for pid, code in dead)
+
+    def _restart_pool(self) -> None:
+        pool = getattr(self, "_pool_cache", None)
+        if pool is not None:
+            self._track_workers()
+            # workers still alive here die by OUR terminate() below —
+            # blaming their SIGTERM exit code in a later report would
+            # misdiagnose a stuck worker as a crashed one
+            purged = getattr(self, "_purged_pids", None)
+            if purged is None:
+                purged = self._purged_pids = set()
+            purged.update(p.pid for p in list(getattr(pool, "_pool", []))
+                          if p.is_alive())
+            try:
+                pool.terminate()
+            except Exception:
+                pass
+            self._pool_cache = None
+
     def __del__(self):
         pool = getattr(self, "_pool_cache", None)
         if pool is not None:
@@ -213,31 +256,65 @@ class DataLoader:
         multiprocessing DataLoader shape. A bounded window of
         apply_async tasks gives backpressure (imap would eagerly
         compute and buffer the whole epoch) while preserving batch
-        order."""
+        order.
+
+        Dead-worker recovery: a worker that dies (``os._exit``, OOM
+        kill, segfault) takes its in-flight task with it — mp.Pool
+        replaces the worker but never completes the task, so the
+        result surfaces as a timeout. On the FIRST timeout the loader
+        restarts the pool and resubmits every pending batch once; a
+        second timeout on the same batch raises, naming the dead
+        workers' exit codes."""
         import collections
         self._check_mp_safe()
         pool = self._pool
         window = max(self._prefetch, self._num_workers)
+        # (indices, async_result): indices are kept so pending work
+        # can be resubmitted to a fresh pool after a worker death
         pending = collections.deque()
         sampler_it = iter(self._batch_sampler)
 
         def fill():
-            while len(pending) < window:
-                try:
+            try:
+                while len(pending) < window:
                     indices = next(sampler_it)
-                except StopIteration:
-                    return
-                pending.append(pool.apply_async(_worker_fn, (indices,)))
+                    pending.append(
+                        (indices,
+                         pool.apply_async(_worker_fn, (indices,))))
+            except StopIteration:
+                pass
+            finally:
+                # register BEFORE any worker can die: a crash between
+                # submission and the timeout report must find its
+                # Process handle (and exit code) in the registry
+                self._track_workers()
 
         fill()
+        retried = False
         while pending:
-            res = pending.popleft()
+            indices, res = pending[0]
             try:
                 batch = res.get(self._timeout)
             except _mp.TimeoutError:
-                raise RuntimeError(
-                    f"DataLoader worker timed out after "
-                    f"{self._timeout}s (dead or stuck worker)")
+                report = self._dead_worker_report()
+                if retried:
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after "
+                        f"{self._timeout}s twice for one batch "
+                        f"({report})")
+                retried = True
+                # one recovery attempt: fresh pool, resubmit all
+                # pending batches in order (completed-but-unread
+                # results from the old pool are recomputed — cheaper
+                # than reasoning about which worker died holding what)
+                self._restart_pool()
+                pool = self._pool
+                pending = collections.deque(
+                    (idx, pool.apply_async(_worker_fn, (idx,)))
+                    for idx, _ in pending)
+                continue
+            retried = False
+            pending.popleft()
             fill()
             yield _np_to_nd(batch)
 

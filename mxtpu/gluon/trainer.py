@@ -257,8 +257,12 @@ class Trainer:
 
     # -- optimizer-state checkpointing (reference save_states/load_states) --
     def save_states(self, fname: str) -> None:
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+        # atomic (tmp + os.replace): a mid-write kill must leave the
+        # previous states file intact, never a torn pickle — same
+        # helper the PS server's crash-recovery snapshot uses
+        from ..base import atomic_write
+        atomic_write(fname, self._updaters[0].get_states(
+            dump_optimizer=False))
 
     def load_states(self, fname: str) -> None:
         with open(fname, "rb") as f:

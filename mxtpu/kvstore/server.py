@@ -20,7 +20,30 @@ Topology: the TPU rebuild has no separate server processes (SURVEY
 §7.0: "the server role disappears") — rank 0 hosts the server as a
 daemon thread and every rank (including 0) talks to it over
 localhost/DCN TCP. This keeps the reference's observable semantics
-with one process role.
+with one process role. ``python -m mxtpu.kvstore.server`` additionally
+runs a STANDALONE server process (the reference's explicit server
+role) so the store can outlive any worker — the kill+restart recovery
+path in docs/robustness.md.
+
+Fault tolerance (docs/robustness.md):
+
+- Every client request travels in a ``("req", client_id, seq, ...)``
+  envelope. The server remembers each client's last (seq, reply) and
+  answers a replayed seq from that cache WITHOUT re-applying — so a
+  retry after a lost ack is exactly-once, and duplicate deliveries
+  are idempotent.
+- ``ServerClient.request`` reconnects with exponential backoff under a
+  deadline on ``ConnectionError``/``OSError``; the socket carries a
+  timeout (``MXTPU_PS_REQUEST_TIMEOUT``) so a HUNG server surfaces as
+  a timeout instead of blocking forever, and each reconnect is
+  verified with a heartbeat ping before the request is replayed.
+- With ``MXTPU_PS_SNAPSHOT_PATH`` set, the server snapshots its store
+  + updater + dedup state to disk (atomic tmp+rename via
+  ``base.atomic_write``) every ``MXTPU_PS_SNAPSHOT_EVERY`` mutations
+  (and/or every ``MXTPU_PS_SNAPSHOT_INTERVAL`` seconds) and reloads it
+  on restart — workers retry through the outage and training continues
+  through a kill+restart. The dedup table rides in the same snapshot
+  so an in-flight retry lands exactly-once across the restart too.
 
 Wire format: length-prefixed frames carrying a SAFE tag-based binary
 encoding (struct headers + raw numpy bytes) — NOT pickle, so a foreign
@@ -30,14 +53,16 @@ matching the reference's ``_send_command_to_servers``) travels as
 opaque bytes and is only *unpickled* when the peer is trusted: the
 frame was HMAC-authenticated (``MXTPU_PS_SECRET``) or the server is
 bound to loopback. Set ``MXTPU_PS_SECRET`` (launch.py forwards it) to
-authenticate every frame with HMAC-SHA256 on multi-host runs.
+authenticate every frame with HMAC-SHA256 on multi-host runs. (The
+snapshot file is also pickle — it is local trusted state under a path
+the operator chose, never network input.)
 
 The HMAC guarantees frame INTEGRITY + peer authentication only — there
-is no nonce/sequence, so an on-path attacker can replay captured
-frames (async-PS pushes are idempotent-ish but replays still perturb
-training). Runs on untrusted networks should ride an encrypted
-transport (WireGuard/stunnel) underneath, as the reference's ps-lite
-deployments did.
+is no nonce, so an on-path attacker can replay captured frames (the
+seq dedup absorbs replays of a client's LAST frame; older replays
+still perturb training). Runs on untrusted networks should ride an
+encrypted transport (WireGuard/stunnel) underneath, as the reference's
+ps-lite deployments did.
 
 The server is host-side numpy, like the reference's CPU-side server
 applying ``sgd_update`` on aggregated grads.
@@ -52,18 +77,30 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as onp
 
-from ..base import MXNetError
+from ..base import (MXNetError, atomic_write, env_float, env_int, env_str)
 
-__all__ = ["KVStoreServer", "ServerClient", "server_address"]
+__all__ = ["KVStoreServer", "ServerClient", "server_address",
+           "PSAuthError", "PSProtocolError"]
 
 _LEN = struct.Struct("<Q")
 _I = struct.Struct("<q")
 _F = struct.Struct("<d")
 _U32 = struct.Struct("<I")
+
+
+class PSAuthError(ConnectionError):
+    """A frame failed HMAC verification — secret mismatch, not a
+    transient network fault. Never retried: retrying an auth failure
+    can only fail the same way until the deadline."""
+
+
+class PSProtocolError(ConnectionError):
+    """The peer sent bytes that are not this protocol (foreign service
+    on the port, torn frame). Never retried."""
 
 
 def server_address() -> tuple:
@@ -162,7 +199,7 @@ def _dec(buf: memoryview, pos: int):
         pos += 4
         dt = onp.dtype(bytes(buf[pos:pos + nd]).decode())
         if dt.hasobject:
-            raise ConnectionError("object dtype on the wire")
+            raise PSProtocolError("object dtype on the wire")
         pos += nd
         (ndim,) = _U32.unpack_from(buf, pos)
         pos += 4
@@ -175,7 +212,7 @@ def _dec(buf: memoryview, pos: int):
         a = onp.frombuffer(bytes(buf[pos:pos + nraw]),
                            dtype=dt).reshape(shape)
         return a, pos + nraw
-    raise ConnectionError(f"bad wire tag {tag} — foreign protocol")
+    raise PSProtocolError(f"bad wire tag {tag} — foreign protocol")
 
 
 _MAX_FRAME = 1 << 33    # 8 GB: anything larger is a foreign protocol
@@ -203,7 +240,7 @@ def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None):
         hdr += chunk
     (n,) = _LEN.unpack(hdr)
     if n > _MAX_FRAME:
-        raise ConnectionError(
+        raise PSProtocolError(
             f"implausible frame length {n} — peer is not an mxtpu "
             "kvstore server")
     buf = bytearray()
@@ -219,7 +256,7 @@ def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None):
         if n < _MAC or not hmac_mod.compare_digest(
                 hmac_mod.new(secret, bytes(buf[_MAC:]),
                              hashlib.sha256).digest(), bytes(buf[:_MAC])):
-            raise ConnectionError("kvstore frame failed HMAC check")
+            raise PSAuthError("kvstore frame failed HMAC check")
         buf = buf[_MAC:]
         authed = True
     try:
@@ -229,22 +266,63 @@ def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None):
     except Exception as e:    # struct.error / TypeError / ValueError
         # from malformed bytes: reject as a protocol error, never let
         # a foreign frame crash the serving thread
-        raise ConnectionError(f"malformed kvstore frame ({e})") from e
+        raise PSProtocolError(f"malformed kvstore frame ({e})") from e
     if pos != len(buf):
-        raise ConnectionError("trailing bytes in kvstore frame")
+        raise PSProtocolError("trailing bytes in kvstore frame")
     return msg, authed
 
 
-class KVStoreServer:
-    """The server role: store + per-push updater, no barriers."""
+# ops that change server state — they trigger snapshots and MUST ride
+# the seq-dedup envelope for exactly-once retry semantics
+_MUTATING_OPS = frozenset({"init", "push", "push_many", "set_optimizer",
+                           "drop_ns", "reset"})
 
-    def __init__(self, host: str, port: int):
+
+class KVStoreServer:
+    """The server role: store + per-push updater, no barriers.
+
+    With ``snapshot_path`` set (or ``MXTPU_PS_SNAPSHOT_PATH``), the
+    store + per-namespace updaters + request-dedup table persist to
+    disk atomically and reload on construction — the crash-recovery
+    path: kill the server, start a new one on the same path, and
+    retrying workers continue exactly where they left off."""
+
+    def __init__(self, host: str, port: int,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_every: Optional[int] = None,
+                 snapshot_interval: Optional[float] = None):
         self._store: Dict[Any, onp.ndarray] = {}
         # one updater per client session namespace (keys arrive as
         # (ns, name) tuples): two live stores must not share an
         # optimizer any more than they share keys
         self._updaters: Dict[Any, Any] = {}
-        self._lock = threading.Lock()
+        # request dedup: client id -> (last seq, last reply). One
+        # in-flight request per client (ServerClient serializes), so
+        # remembering only the latest exchange is sufficient.
+        self._sessions: Dict[str, Tuple[int, Any]] = {}
+        # RLock: _dispatch holds it across dedup-check + handle +
+        # session-record + snapshot so a crash can never be observed
+        # between an applied update and its dedup entry
+        self._lock = threading.RLock()
+        if snapshot_path is None:
+            snapshot_path = env_str(
+                "MXTPU_PS_SNAPSHOT_PATH", "",
+                "Parameter-server crash-recovery snapshot file; empty "
+                "disables snapshots.") or None
+        self._snap_path = snapshot_path
+        self._snap_every = snapshot_every if snapshot_every is not None \
+            else env_int("MXTPU_PS_SNAPSHOT_EVERY", 1,
+                         "Snapshot the PS store every N mutations "
+                         "(<=0 disables the count trigger).")
+        self._snap_interval = snapshot_interval \
+            if snapshot_interval is not None \
+            else env_float("MXTPU_PS_SNAPSHOT_INTERVAL", 0.0,
+                           "Also snapshot the PS store every N seconds "
+                           "(<=0 disables the time trigger).")
+        self._mutations_since_snap = 0
+        self._last_snap_time = time.monotonic()
+        if self._snap_path:
+            self._load_snapshot()
         # captured once: a later env mutation must not silently change
         # what this server authenticates against
         self._secret = _wire_secret()
@@ -265,6 +343,85 @@ class KVStoreServer:
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
         self._thread.start()
+        if self._snap_path and self._snap_interval > 0:
+            # the mutation-gated check in _maybe_snapshot never fires
+            # on an idle server — the timer persists trailing
+            # mutations once the interval elapses
+            threading.Thread(target=self._snapshot_timer,
+                             daemon=True).start()
+
+    # -- crash-recovery snapshots ----------------------------------------
+    def _load_snapshot(self) -> None:
+        path = self._snap_path
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            self._store = blob["store"]
+            self._updaters = blob["updaters"]
+            self._sessions = blob.get("sessions", {})
+        except Exception as e:
+            # atomic_write means a torn file should be impossible; an
+            # unreadable snapshot (version skew, manual edit) must not
+            # brick the server — start empty and say so
+            import warnings
+            warnings.warn(
+                f"kvstore snapshot {path!r} unreadable ({e!r}); "
+                "starting with an empty store", RuntimeWarning)
+
+    def _write_snapshot(self) -> None:
+        """Persist store + updaters + dedup sessions (lock held). The
+        dedup table MUST ride along: it is what makes a worker's
+        retried in-flight request exactly-once across the restart."""
+        if not self._snap_path:
+            return
+        blob = pickle.dumps({"store": self._store,
+                             "updaters": self._updaters,
+                             "sessions": self._sessions},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        atomic_write(self._snap_path, blob)
+        self._mutations_since_snap = 0
+        self._last_snap_time = time.monotonic()
+
+    def _maybe_snapshot(self) -> None:
+        """Called (lock held) after each mutating op. A failing write
+        (disk full, unpicklable updater) degrades durability, not
+        availability: warn once and keep serving."""
+        if not self._snap_path:
+            return
+        self._mutations_since_snap += 1
+        due = (self._snap_every > 0
+               and self._mutations_since_snap >= self._snap_every)
+        if not due and self._snap_interval > 0:
+            due = (time.monotonic() - self._last_snap_time
+                   >= self._snap_interval)
+        if not due:
+            return
+        try:
+            self._write_snapshot()
+        except Exception as e:
+            if not getattr(self, "_snap_warned", False):
+                self._snap_warned = True
+                import warnings
+                warnings.warn(
+                    f"kvstore snapshot to {self._snap_path!r} failed "
+                    f"({e!r}) — serving continues WITHOUT crash "
+                    "recovery", RuntimeWarning)
+
+    def _snapshot_timer(self):
+        while self._running:
+            time.sleep(min(self._snap_interval, 1.0))
+            with self._lock:
+                if not self._running:
+                    return
+                if self._mutations_since_snap > 0 and \
+                        (time.monotonic() - self._last_snap_time
+                         >= self._snap_interval):
+                    try:
+                        self._write_snapshot()
+                    except Exception:
+                        pass    # _maybe_snapshot already warned
 
     def _accept_loop(self):
         while self._running:
@@ -280,16 +437,68 @@ class KVStoreServer:
             while True:
                 try:
                     msg, authed = _recv_msg(conn, self._secret)
+                except (PSAuthError, PSProtocolError) as e:
+                    # the peer is ALIVE but unauthenticated/foreign:
+                    # best-effort plaintext error so it fails fast
+                    # (a secret-bearing client sees the unauthenticated
+                    # reply as PSAuthError and stops retrying) instead
+                    # of silently retrying against a closed socket
+                    try:
+                        _send_msg(conn, ("err", f"rejected: {e}"), b"")
+                    except OSError:
+                        pass
+                    return
                 except (ConnectionError, OSError):
                     return
-                try:
-                    reply = self._handle(msg, authed)
-                except Exception as e:      # surface server errors to
-                    reply = ("err", repr(e))  # the pushing worker
+                reply = self._dispatch(msg, authed)
                 try:
                     _send_msg(conn, reply, self._secret)
                 except (ConnectionError, OSError):
                     return
+
+    def _dispatch(self, msg, authed: bool = False):
+        """Unwrap the retry envelope, dedup replays, handle, snapshot.
+        Applied-update + dedup-entry + snapshot are one critical
+        section: a kill can only land before all three (retry
+        re-applies onto the pre-request snapshot) or after (retry is
+        answered from the dedup cache) — never double-apply."""
+        if isinstance(msg, tuple) and len(msg) == 4 and msg[0] == "req" \
+                and isinstance(msg[1], str) and isinstance(msg[2], int):
+            _, cid, seq, inner = msg
+            if not (isinstance(inner, tuple) and inner):
+                return ("err", "malformed request envelope")
+            with self._lock:
+                last = self._sessions.get(cid)
+                if last is not None and last[0] == seq:
+                    # duplicate delivery. Mutations replay the CACHED
+                    # ack; reads are idempotent and re-execute (their
+                    # replies — full parameter pulls — are never
+                    # cached, keeping the session table and every
+                    # snapshot small)
+                    if last[1] is not None:
+                        return last[1]
+                    return self._handle_safely(inner, authed)
+                if last is not None and seq < last[0]:
+                    return ("err", f"stale request seq {seq} < {last[0]}")
+                reply = self._handle_safely(inner, authed)
+                mutating = inner[0] in _MUTATING_OPS
+                self._sessions[cid] = (seq, reply if mutating else None)
+                if mutating:
+                    self._maybe_snapshot()
+            return reply
+        # bare message: heartbeat pings and pre-envelope peers
+        with self._lock:
+            reply = self._handle_safely(msg, authed)
+            if isinstance(msg, tuple) and msg \
+                    and msg[0] in _MUTATING_OPS:
+                self._maybe_snapshot()
+        return reply
+
+    def _handle_safely(self, msg, authed: bool):
+        try:
+            return self._handle(msg, authed)
+        except Exception as e:          # surface server errors to
+            return ("err", repr(e))     # the pushing worker
 
     def _handle(self, msg, authed: bool = False):
         op = msg[0]
@@ -398,6 +607,12 @@ class KVStoreServer:
 
     def stop(self):
         self._running = False
+        with self._lock:
+            if self._snap_path:
+                try:                      # graceful exits keep the
+                    self._write_snapshot()  # freshest possible state
+                except Exception:         # incl. pickle failures —
+                    pass                   # same tolerance as serving
         try:
             self._sock.close()
         except OSError:
@@ -442,7 +657,14 @@ class _NumpyUpdater:
 class ServerClient:
     """Worker-side connection to the async PS (one persistent socket,
     locked — pushes from one worker are ordered, like one ps-lite
-    customer channel)."""
+    customer channel).
+
+    Resilient: every ``request`` carries a (client_id, seq) envelope;
+    on ``ConnectionError``/``OSError``/timeout the client reconnects
+    with exponential backoff under ``MXTPU_PS_RETRY_DEADLINE``,
+    heartbeat-pings the reconnected server, and replays the SAME
+    envelope — the server's dedup table makes the retry exactly-once
+    whether or not the original delivery was applied."""
 
     def __init__(self, host: Optional[str] = None,
                  port: Optional[int] = None, timeout: float = 60.0):
@@ -451,31 +673,182 @@ class ServerClient:
         self._addr = (host, port)
         self._secret = _wire_secret()
         self._lock = threading.Lock()
-        deadline = time.time() + timeout
+        self._cid = os.urandom(8).hex()
+        self._seq = 0
+        self._sock: Optional[socket.socket] = None
+        self._request_timeout = env_float(
+            "MXTPU_PS_REQUEST_TIMEOUT", 60.0,
+            "Per-socket-op timeout talking to the parameter server; a "
+            "hung server surfaces as a timeout + retry, never a hang.")
+        self._retry_deadline = env_float(
+            "MXTPU_PS_RETRY_DEADLINE", 120.0,
+            "Total reconnect+retry budget per PS request before the "
+            "worker raises (covers a server kill+restart window).")
+        self._backoff_base = env_float(
+            "MXTPU_PS_BACKOFF_BASE", 0.05,
+            "Initial reconnect backoff (seconds), doubled per attempt.")
+        self._backoff_max = env_float(
+            "MXTPU_PS_BACKOFF_MAX", 2.0,
+            "Reconnect backoff ceiling (seconds).")
+        # test-only fault injection hook (mxtpu.contrib.chaos): called
+        # around each send so drops/dups/delays are deterministic
+        self.chaos = None
+        self._connect(time.monotonic() + timeout, verify=False)
+
+    # -- connection management -------------------------------------------
+    def _connect(self, deadline: float, verify: bool = True) -> None:
+        delay = self._backoff_base
         last = None
         while True:
             try:
-                self._sock = socket.create_connection(self._addr,
-                                                      timeout=timeout)
-                break
-            except OSError as e:       # server may not be up yet
+                sock = socket.create_connection(
+                    self._addr, timeout=max(0.1, self._request_timeout))
+                sock.settimeout(self._request_timeout)
+                if verify:
+                    # heartbeat: a freshly-accepted-but-hung or foreign
+                    # server must fail HERE (timeout/protocol error),
+                    # not after we replay a mutating request into it
+                    _send_msg(sock, ("ping",), self._secret)
+                    reply, _ = _recv_msg(sock, self._secret)
+                    if len(reply) < 2 or reply[1] != "mxtpu-ps":
+                        sock.close()
+                        raise PSProtocolError(
+                            f"service at {self._addr} is not an mxtpu "
+                            "kvstore server")
+                self._sock = sock
+                return
+            except (PSAuthError, PSProtocolError):
+                raise               # not transient — see class docs
+            except OSError as e:    # server may not be up yet
                 last = e
-                if time.time() > deadline:
+                now = time.monotonic()
+                if now >= deadline:
                     raise MXNetError(
                         f"cannot reach kvstore server at {self._addr}: "
-                        f"{last}")
-                time.sleep(0.05)
+                        f"{last}") from last
+                time.sleep(min(delay, max(0.01, deadline - now)))
+                delay = min(delay * 2, self._backoff_max)
 
+    def _drop_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def ping(self, timeout: Optional[float] = None):
+        """Heartbeat: round-trip a bare ping (no envelope — pings must
+        not advance the dedup seq). Raises on a dead/hung server."""
+        with self._lock:
+            if self._sock is None:
+                self._connect(time.monotonic()
+                              + (timeout or self._request_timeout))
+            old = self._sock.gettimeout()
+            try:
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
+                _send_msg(self._sock, ("ping",), self._secret)
+                reply, _ = _recv_msg(self._sock, self._secret)
+            except (ConnectionError, OSError):
+                self._drop_socket()
+                raise
+            if old is not None and self._sock is not None:
+                self._sock.settimeout(old)
+        return reply
+
+    # -- requests ----------------------------------------------------------
     def request(self, *msg):
         with self._lock:
-            _send_msg(self._sock, msg, self._secret)
-            reply, _ = _recv_msg(self._sock, self._secret)
+            self._seq += 1
+            envelope = ("req", self._cid, self._seq, msg)
+            reply = self._roundtrip(envelope)
         if reply[0] == "err":
             raise MXNetError(f"kvstore server: {reply[1]}")
         return reply
 
+    def _roundtrip(self, envelope):
+        deadline = time.monotonic() + self._retry_deadline
+        delay = self._backoff_base
+        attempt = 0
+        # fresh logical request: every later attempt in this loop is a
+        # retry (chaos fault schedules index logical requests, so only
+        # the first attempt may consume a schedule slot)
+        self._chaos_retrying = False
+        while True:
+            try:
+                if self._sock is None:
+                    # reconnect path: heartbeat-verified (see _connect)
+                    self._connect(deadline, verify=True)
+                chaos = self.chaos
+                if chaos is not None:
+                    chaos.on_request(self)
+                _send_msg(self._sock, envelope, self._secret)
+                if chaos is not None:
+                    chaos.on_sent(self)
+                reply, _ = _recv_msg(self._sock, self._secret)
+                return reply
+            except PSAuthError as e:
+                self._drop_socket()
+                raise MXNetError(
+                    f"kvstore server at {self._addr}: {e} — "
+                    "MXTPU_PS_SECRET mismatch between worker and "
+                    "server") from e
+            except PSProtocolError as e:
+                self._drop_socket()
+                raise MXNetError(
+                    f"kvstore server at {self._addr}: {e}") from e
+            except (ConnectionError, OSError) as e:
+                self._drop_socket()
+                attempt += 1
+                now = time.monotonic()
+                if now >= deadline:
+                    raise MXNetError(
+                        f"kvstore server at {self._addr} unreachable "
+                        f"after {attempt} attempts "
+                        f"({self._retry_deadline:.0f}s): {e}") from e
+                time.sleep(min(delay, max(0.0, deadline - now)))
+                delay = min(delay * 2, self._backoff_max)
+
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop_socket()
+
+
+def main(argv=None) -> int:
+    """Standalone server process: ``python -m mxtpu.kvstore.server``.
+
+    The reference ran explicit server roles (``DMLC_ROLE=server``);
+    here the standalone process exists so the store can OUTLIVE any
+    worker — combined with ``--snapshot-path`` it is the kill+restart
+    recovery unit exercised by tests/test_fault_tolerance.py. SIGTERM/
+    SIGINT snapshot and exit cleanly."""
+    import argparse
+    import signal as _signal
+    p = argparse.ArgumentParser(description=main.__doc__)
+    default_host, default_port = server_address()
+    p.add_argument("--host", default=default_host)
+    p.add_argument("--port", type=int, default=default_port)
+    p.add_argument("--snapshot-path", default=None,
+                   help="crash-recovery snapshot file "
+                        "(default: $MXTPU_PS_SNAPSHOT_PATH)")
+    p.add_argument("--snapshot-every", type=int, default=None,
+                   help="snapshot every N mutations "
+                        "(default: $MXTPU_PS_SNAPSHOT_EVERY or 1)")
+    p.add_argument("--snapshot-interval", type=float, default=None,
+                   help="also snapshot every N seconds")
+    a = p.parse_args(argv)
+    srv = KVStoreServer(a.host, a.port, snapshot_path=a.snapshot_path,
+                        snapshot_every=a.snapshot_every,
+                        snapshot_interval=a.snapshot_interval)
+    stop = threading.Event()
+    for s in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(s, lambda *_: stop.set())
+    print(f"mxtpu-ps listening on {a.host}:{a.port}", flush=True)
+    while not stop.is_set() and srv._running:
+        stop.wait(0.2)
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
