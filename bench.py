@@ -45,12 +45,19 @@ def _time_steps(step_fn, state, batch, warmup=3, steps=20):
     return (time.perf_counter() - t0) / steps
 
 
-def bench_resnet(batch=256, steps=30):
+def bench_resnet(batch=256, steps=30, stem=None):
+    """ResNet-50 train step. ``stem`` defaults to the TPU-aware choice
+    (s2d on accelerator backends, std on CPU; MXTPU_RESNET_STEM
+    overrides — docs/env_var.md). Both stems are the SAME model (exact
+    kernel rewrite, see mxtpu/models/resnet.py), so img/s are directly
+    comparable and MFU uses the same useful-FLOP numerator (the s2d
+    kernel's structurally-zero taps are not useful work)."""
     from mxtpu.models import resnet
     from mxtpu.parallel import mesh as pmesh, step as pstep
     from mxtpu.parallel.sharding import ShardingRules, P
 
-    cfg = resnet.CONFIGS["resnet50"]
+    stem = stem or resnet.default_stem()
+    cfg = resnet.CONFIGS["resnet50_s2d" if stem == "s2d" else "resnet50"]
     mesh = pmesh.create_mesh(dp=-1)
     rules = ShardingRules([(r".*", P())])
     params = resnet.init_params(cfg, jax.random.PRNGKey(0))
@@ -73,7 +80,7 @@ def bench_resnet(batch=256, steps=30):
     # consistent with V5E_PEAK_FLOPS — the folklore "4.1 GFLOPs"
     # figure counts MACs)
     mfu = img_s * 23.9e9 / V5E_PEAK_FLOPS
-    return img_s, mfu
+    return img_s, mfu, stem
 
 
 def _dense_param_count(params, exclude_keys):
@@ -559,26 +566,231 @@ def bench_input_pipeline():
     return rec
 
 
+def _smoke_llama_cfg():
+    """The one tiny CPU-safe config shared by bench_smoke_run and the
+    perf gate's smoke path — a single definition so the two CI stages
+    cannot drift onto different models."""
+    from mxtpu.models import llama
+    return llama.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=128, max_seq_len=64, attn_impl="blockwise")
+
+
 def bench_smoke_run():
     """One REAL train step on a tiny llama config — CI's bench-path
     regression check (a jit/shape break here fails bench_smoke)."""
-    from mxtpu.models import llama
-    cfg = llama.LlamaConfig(
-        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
-        hidden_dim=128, max_seq_len=64, attn_impl="blockwise")
-    t_s, mfu, n_p = bench_llama(batch=2, seq=64, steps=2, cfg=cfg)
+    t_s, mfu, n_p = bench_llama(batch=2, seq=64, steps=2,
+                                cfg=_smoke_llama_cfg())
     return {"metric": "smoke_llama_tokens_per_s", "value": round(t_s, 1),
             "unit": "tok/s", "mfu": round(mfu, 4), "n_params": n_p,
             "vs_baseline": 1.0}
 
 
+# ---------------------------------------------------------------------------
+# whole-model perf regression gate (VERDICT r5 #5): per-config
+# step-time/MFU vs the committed benchmark/baseline_models.json.
+# The model-level analogue of benchmark/opperf's latency gate —
+# a remat/sharding/lowering regression in any flagship step must fail
+# CI loudly instead of surfacing as a silent BENCH_rNN diff.
+# ---------------------------------------------------------------------------
+BASELINE_MODELS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmark", "baseline_models.json")
+
+
+def _gate_resnet(stem):
+    img_s, mfu, _ = bench_resnet(stem=stem)
+    return {"step_ms": round(256 / img_s * 1000, 2), "mfu": round(mfu, 3),
+            "throughput": round(img_s, 1), "unit": "img/s", "batch": 256}
+
+
+def _gate_bert():
+    s_s, mfu = bench_bert()
+    return {"step_ms": round(128 / s_s * 1000, 2), "mfu": round(mfu, 3),
+            "throughput": round(s_s, 1), "unit": "samples/s", "batch": 128}
+
+
+def _gate_llama():
+    t_s, mfu, _ = bench_llama()
+    return {"step_ms": round(4 * 2048 / t_s * 1000, 2),
+            "mfu": round(mfu, 3), "throughput": round(t_s, 1),
+            "unit": "tok/s", "batch": 4}
+
+
+def _gate_smoke_llama():
+    """CPU-safe tiny config — exercises the same measurement path so
+    the gate plumbing is testable without a chip. Batch 8 so the dp
+    mesh divides on any 1/2/4/8-device box (the tier-1 gate test runs
+    under the suite's 8-virtual-device XLA_FLAGS)."""
+    t_s, mfu, _ = bench_llama(batch=8, seq=64, steps=6,
+                              cfg=_smoke_llama_cfg())
+    return {"step_ms": round(8 * 64 / t_s * 1000, 2),
+            "mfu": round(mfu, 4), "throughput": round(t_s, 1),
+            "unit": "tok/s", "batch": 8}
+
+
+GATE_CONFIGS = {
+    "resnet50": lambda: _gate_resnet("std"),
+    "resnet50_s2d": lambda: _gate_resnet("s2d"),
+    "bert_base": _gate_bert,
+    "llama_509m": _gate_llama,
+    "smoke_llama": _gate_smoke_llama,
+}
+
+
+def _gate_injections():
+    """MXTPU_BENCH_INJECT='name:factor,...' multiplies the measured
+    step_ms — the gate's seeded-regression hook (tests/test_bench_gate
+    .py), mirroring MXTPU_OPPERF_INJECT."""
+    out = {}
+    for part in os.environ.get("MXTPU_BENCH_INJECT", "").split(","):
+        if ":" in part:
+            name, factor = part.rsplit(":", 1)
+            out[name.strip()] = float(factor)
+    return out
+
+
+def gate_measure(names):
+    inject = _gate_injections()
+    recs = {}
+    for name in names:
+        if name not in GATE_CONFIGS:
+            raise SystemExit(f"unknown gate config {name!r}; have "
+                             f"{sorted(GATE_CONFIGS)}")
+        rec = GATE_CONFIGS[name]()
+        if name in inject:
+            rec["step_ms"] = round(rec["step_ms"] * inject[name], 2)
+            rec["injected"] = inject[name]
+        recs[name] = rec
+    return recs
+
+
+def gate_compare(baseline, current, tolerance):
+    """Pure compare: every baseline config must be present and within
+    ``tolerance × baseline step_ms``. Returns (violations, lines);
+    faster-than-baseline is reported (re-baseline nudge) but passes."""
+    violations, lines = [], []
+    for name, base in sorted(baseline.items()):
+        cur = current.get(name)
+        if cur is None:
+            violations.append(name)
+            lines.append(f"MISSING {name}: in baseline but not in this "
+                         f"run (the baseline is a contract)")
+            continue
+        ratio = cur["step_ms"] / base["step_ms"]
+        if ratio > tolerance:
+            violations.append(name)
+            lines.append(
+                f"REGRESSION {name}: {cur['step_ms']:.2f} ms/step vs "
+                f"baseline {base['step_ms']:.2f} ({ratio:.2f}x > "
+                f"{tolerance:.2f}x)")
+        else:
+            note = " (faster: consider bench_gate_baseline)" \
+                if ratio < 1 / tolerance else ""
+            lines.append(f"ok {name}: {cur['step_ms']:.2f} ms/step "
+                         f"({ratio:.2f}x baseline){note}")
+    for name in sorted(set(current) - set(baseline)):
+        lines.append(f"new {name}: {current[name]['step_ms']:.2f} "
+                     f"ms/step — not in baseline, not gated (add via "
+                     f"bench_gate_baseline)")
+    return violations, lines
+
+
+def main_gate(argv):
+    import argparse
+    p = argparse.ArgumentParser(prog="bench.py gate")
+    p.add_argument("--configs", default=None,
+                   help="comma list (default: configs in the baseline, "
+                        "or the chip flagship set with --update)")
+    p.add_argument("--baseline", default=BASELINE_MODELS)
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="step-time band (default: baseline file's, "
+                        "else 1.25)")
+    p.add_argument("--update", action="store_true",
+                   help="write the measured records as the baseline")
+    p.add_argument("--out", default=None,
+                   help="also write this run's records to a json")
+    p.add_argument("--replay", default=None,
+                   help="compare a previously-written run json instead "
+                        "of measuring (pure gate-logic path)")
+    args = p.parse_args(argv)
+
+    base = {}
+    tol = args.tolerance
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            doc = json.load(f)
+        if not args.update:
+            base = doc["configs"]
+        if tol is None:
+            # --update inherits the file's tolerance too: an operator-
+            # widened band must survive a baseline refresh
+            tol = doc.get("tolerance", 1.25)
+    tol = tol or 1.25
+
+    if not base and not args.update and not args.replay:
+        # fail BEFORE burning minutes of measurement that would only be
+        # thrown away by the same error below
+        raise SystemExit(f"no baseline at {args.baseline}; run with "
+                         f"--update on a chip box first")
+
+    flagship = ["resnet50", "resnet50_s2d", "bert_base", "llama_509m"]
+    if args.replay:
+        with open(args.replay) as f:
+            current = json.load(f)["configs"]
+    else:
+        # default: every gated config PLUS the flagship set, so a new
+        # config (e.g. resnet50_s2d before its first chip baseline) is
+        # measured and reported even though it does not gate yet
+        names = (args.configs.split(",") if args.configs
+                 else sorted(set(base) | set(flagship)) if base
+                 else flagship)
+        current = gate_measure(names)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"configs": current, "tolerance": tol}, f,
+                      indent=1, sort_keys=True)
+    if args.update:
+        with open(args.baseline, "w") as f:
+            json.dump({"configs": current, "tolerance": tol,
+                       "_provenance": "bench.py gate --update; refresh "
+                       "on intentional change via ci/runtime_functions"
+                       ".sh bench_gate_baseline (real-chip box)"},
+                      f, indent=1, sort_keys=True)
+        print(f"bench_gate: baseline written to {args.baseline} "
+              f"({len(current)} configs)")
+        return 0
+
+    if not base:
+        raise SystemExit(f"no baseline at {args.baseline}; run with "
+                         f"--update on a chip box first")
+    violations, lines = gate_compare(base, current, tol)
+    if violations and not args.replay:
+        # tunnel-aware: re-time violators once before failing (axon
+        # dispatch jitter — same policy as opperf_gate)
+        retimed = gate_measure([v for v in violations if v in current])
+        for name, rec in retimed.items():
+            if rec["step_ms"] < current[name]["step_ms"]:
+                current[name] = rec
+        violations, lines = gate_compare(base, current, tol)
+    print("\n".join(lines))
+    if violations:
+        print(f"bench_gate: FAIL ({len(violations)} violation(s))")
+        return 1
+    print(f"bench_gate: OK ({len(base)} configs within {tol:.2f}x)")
+    return 0
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "gate":
+        raise SystemExit(main_gate(sys.argv[2:]))
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
     if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b",
                     "aot8b_decode", "aot_moe", "aot8b_int8", "aot8b_32k", "input"):
         raise SystemExit(
             "usage: bench.py [all|resnet|bert|llama|smoke|aot8b|"
-            f"aot8b_decode|aot_moe|aot8b_int8|aot8b_32k|input] (got {only!r})")
+            "aot8b_decode|aot_moe|aot8b_int8|aot8b_32k|input|"
+            f"gate ...] (got {only!r})")
     if only == "smoke":
         print(json.dumps(bench_smoke_run()))
         return
@@ -599,8 +811,18 @@ def main():
         return
     extras = []
     img_s = mfu_r = 0.0
+    stem = "std"
     if only in ("all", "resnet"):
-        img_s, mfu_r = bench_resnet()
+        img_s, mfu_r, stem = bench_resnet()
+        if stem != "std":
+            # the headline rides the default (s2d on TPU); keep the
+            # standard stem in the record so the delta is driver-visible
+            img_std, mfu_std, _ = bench_resnet(stem="std")
+            extras.append({"metric": "resnet50_std_stem_img_s",
+                           "value": round(img_std, 1), "unit": "img/s",
+                           "mfu": round(mfu_std, 3), "stem": "std",
+                           "vs_baseline": round(
+                               img_std / BASELINE_RESNET_IMG_S, 3)})
     if only in ("all", "bert"):
         s_s, mfu_b = bench_bert()
         extras.append({"metric": "bert_base_pretrain_samples_per_s",
@@ -633,6 +855,7 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_RESNET_IMG_S, 3),
         "mfu": round(mfu_r, 3),
+        "stem": stem,
         "extra": extras,
     }
     if only != "all" and extras:

@@ -187,6 +187,21 @@ def main():
         time_iter_fenced(it, args.seconds), 1)
     it.close()
 
+    # same leg behind the double-buffered DevicePrefetcher: decode +
+    # dispatch move to a background thread, so the upload of batch k+1
+    # overlaps the consumer's work on batch k (docs/perf.md prefetch-
+    # overlap subsection; same scalar fence — the gain is real overlap,
+    # not unfenced fiction)
+    from mxtpu.gluon.data import DevicePrefetcher
+    it = mio.ImageRecordIter(
+        path_imgrec=rec, data_shape=shape,
+        batch_size=args.batch_size, shuffle=False, preprocess_threads=2)
+    pf = DevicePrefetcher(it)
+    time_iter_fenced(pf, 0.5)                  # warm up + compile
+    results["prefetched_delivered_img_s"] = round(
+        time_iter_fenced(pf, args.seconds), 1)
+    pf.close()
+
     # contrast: the Python ImageIter path (force it via an aug flag).
     # batch 8: at ~3 img/s a 64-image batch holds the prefetch worker
     # in TF decode for ~20 s, which close() would have to wait out

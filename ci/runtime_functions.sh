@@ -145,6 +145,68 @@ PYEOF
 # back-compat name (round-4 CI docs referenced opperf_coverage)
 opperf_coverage() { opperf_gate "$@"; }
 
+bench_gate() {
+    # VERDICT r5 #5: whole-model step-time/MFU gate — the model-level
+    # analogue of opperf_gate. On a chip box the flagship configs are
+    # re-measured and compared against the committed
+    # benchmark/baseline_models.json (tolerance band in the file,
+    # violators re-timed once — axon-tunnel-aware, like opperf). On
+    # CPU-only boxes chip latencies are meaningless, so the gate
+    # instead (a) validates the committed baseline's structure and
+    # (b) runs a live mini-gate on the CPU-safe smoke config against a
+    # freshly-measured self-baseline, which proves the measure+compare
+    # plumbing end to end (MXTPU_BENCH_INJECT seeds a regression; the
+    # exact 10%-regression logic contract is tier-1-gated in
+    # tests/test_bench_gate.py).
+    python - << 'PYEOF'
+import json, os, subprocess, sys, tempfile
+on_chip = False
+try:
+    import jax
+    on_chip = jax.devices()[0].platform not in ("cpu",)
+except Exception:
+    pass
+baseline = "benchmark/baseline_models.json"
+doc = json.load(open(baseline))
+assert doc["configs"], "empty baseline"
+for name, rec in doc["configs"].items():
+    assert rec["step_ms"] > 0, (name, rec)
+env = dict(os.environ)
+if on_chip:
+    cmd = [sys.executable, "bench.py", "gate", "--baseline", baseline]
+else:
+    env["JAX_PLATFORMS"] = "cpu"
+    tmp = os.path.join(tempfile.mkdtemp(), "self_base.json")
+    mk = subprocess.run(
+        [sys.executable, "bench.py", "gate", "--configs", "smoke_llama",
+         "--baseline", tmp, "--update"],
+        capture_output=True, text=True, timeout=1200,
+        env={k: v for k, v in env.items()
+             if k != "MXTPU_BENCH_INJECT"})
+    assert mk.returncode == 0, mk.stderr[-2000:] + mk.stdout[-500:]
+    cmd = [sys.executable, "bench.py", "gate", "--baseline", tmp,
+           "--tolerance", "2.0", "--configs", "smoke_llama"]
+out = subprocess.run(cmd, capture_output=True, text=True,
+                     timeout=3600, env=env)
+sys.stdout.write(out.stdout[-2000:])
+if out.returncode != 0:
+    sys.stderr.write(out.stderr[-1000:])
+    sys.exit(1)
+mode = "chip step-time gate" if on_chip else \
+    "baseline structure + smoke plumbing (no chip)"
+print(f"bench_gate: OK ({mode})")
+PYEOF
+}
+
+bench_gate_baseline() {
+    # refresh the committed whole-model baseline (run on a real-chip
+    # box, then commit the json — intentional-change workflow, the
+    # sibling of opperf_baseline)
+    python bench.py gate --update \
+        --configs resnet50,resnet50_s2d,bert_base,llama_509m
+    echo "bench_gate_baseline: wrote benchmark/baseline_models.json"
+}
+
 opperf_baseline() {
     # refresh the committed chip baseline (run on a real-chip box,
     # then commit the json — intentional-change workflow)
@@ -161,6 +223,25 @@ ci_all() {
     multichip_dryrun
     bench_smoke
     opperf_coverage
+    bench_gate
 }
+
+ci_fast() {
+    # the default inner loop (VERDICT r5 #7): lint + the not-slow unit
+    # tier + the bench-path smoke — minutes, not the 52-minute ci_all.
+    # Run ci_all (full suite, dist/chaos/dryrun/opperf) before a
+    # snapshot or when touching distributed/CI surfaces.
+    sanity_check
+    mxlint
+    unittest_fast
+    bench_smoke
+}
+
+# no-argument invocation runs the fast inner loop, so the cheap,
+# always-appropriate check is also the default one (VERDICT r5 #7: an
+# untested snapshot happened because the fast path wasn't the default)
+if [ "$#" -eq 0 ]; then
+    set -- ci_fast
+fi
 
 "$@"

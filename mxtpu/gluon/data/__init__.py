@@ -2,4 +2,5 @@
 from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
 from .dataloader import DataLoader, default_batchify_fn
+from .prefetcher import DevicePrefetcher
 from . import vision

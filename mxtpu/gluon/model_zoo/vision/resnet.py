@@ -5,6 +5,13 @@ unverified]; He et al. 1512.03385, 1603.05027).
 V1: conv→bn→relu blocks with post-addition relu. V2: pre-activation
 (bn→relu→conv). Same layer/channel schedules as the reference so
 exported checkpoints map name-for-name.
+
+``stem="s2d"`` swaps the 7×7/stride-2/pad-3 stem conv for
+:class:`SpaceToDepthStem` — the exact space-to-depth rewrite of the
+same conv (the TPU input-stem trick; see ``mxtpu/models/resnet.py``).
+The stem block keeps the standard (channels, in, 7, 7) weight under
+the same structural name (``features.0.weight``), so checkpoints load
+unchanged across stems in BOTH directions and no converter is needed.
 """
 from __future__ import annotations
 
@@ -12,7 +19,8 @@ from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
-           "BottleneckV1", "BottleneckV2", "get_resnet",
+           "BottleneckV1", "BottleneckV2", "SpaceToDepthStem",
+           "get_resnet",
            "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
            "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
            "resnet101_v2", "resnet152_v2"]
@@ -21,6 +29,77 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
 def _conv3x3(channels, stride, in_channels):
     return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
                      use_bias=False, in_channels=in_channels)
+
+
+class SpaceToDepthStem(HybridBlock):
+    """Exact space-to-depth rewrite of ``Conv2D(channels, 7, 2, 3,
+    use_bias=False)``: 2×2 space-to-depth fattens the 3-channel input
+    to 12 channels, then a 4×4/stride-1 conv reproduces the centered
+    7×7/stride-2/pad-3 conv tap-for-tap.
+
+    The weight parameter STAYS (channels, in_channels, 7, 7): the
+    equivalent (channels, 4·in, 4, 4) kernel is derived in-forward by a
+    linear permute+pad of the 7×7 tensor (negligible next to the conv),
+    so standard-stem checkpoints load unchanged and gradients/
+    trajectories match the standard stem exactly.
+
+    Mapping (centered pad-3 convention, vs the functional core's SAME):
+    output o reads pixels 2o-3…2o+3 = blocks o-2…o+1 = window
+    2o-4…2o+3, whose FIRST tap is phantom — so the 7-tap kernel
+    zero-pads to 8 at the front, and the s2d input pads (2,1) per
+    spatial axis."""
+
+    def __init__(self, channels, in_channels=0, weight_initializer=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels, 7, 7),
+                init=weight_initializer, allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        shape = list(self.weight.shape)
+        shape[1] = x.shape[1]
+        self.weight.shape = tuple(shape)
+        self._in_channels = x.shape[1]
+
+    def hybrid_forward(self, F, x, weight):
+        xshape = getattr(x, "shape", None)
+        if xshape is not None and len(xshape) == 4 and \
+                all(isinstance(d, int) for d in xshape[2:]) and \
+                (xshape[2] % 2 or xshape[3] % 2):
+            raise ValueError(
+                f"stem='s2d' needs even spatial dims, got "
+                f"{tuple(xshape[2:])}; use the standard stem for "
+                f"odd-sized inputs")
+        # weight.shape is authoritative whether the param arrived via
+        # deferred init (infer_shape) or load_parameters
+        o, c = self._channels, self.weight.shape[1]
+        w8 = F.pad(weight, mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 0, 1, 0))
+        w = F.reshape(w8, shape=(o, c, 4, 2, 4, 2))
+        w = F.transpose(w, axes=(0, 3, 5, 1, 2, 4))
+        w = F.reshape(w, shape=(o, 4 * c, 4, 4))
+        y = F.space_to_depth(x, block_size=2)
+        y = F.pad(y, mode="constant",
+                  pad_width=(0, 0, 0, 0, 2, 1, 2, 1))
+        return F.Convolution(y, w, None, no_bias=True, kernel=(4, 4),
+                             stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                             num_filter=o, num_group=1, layout="NCHW")
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}({self.weight.shape}, "
+                f"block=2)")
+
+
+def _make_stem(channels, stem):
+    if stem == "s2d":
+        return SpaceToDepthStem(channels)
+    if stem != "std":
+        raise ValueError(f"stem must be 'std' or 's2d', got {stem!r}")
+    return nn.Conv2D(channels, 7, 2, 3, use_bias=False)
 
 
 class BasicBlockV1(HybridBlock):
@@ -144,7 +223,7 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, stem="std", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -152,8 +231,7 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
+                self.features.add(_make_stem(channels[0], stem))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))
@@ -183,7 +261,7 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, stem="std", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -192,8 +270,7 @@ class ResNetV2(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
+                self.features.add(_make_stem(channels[0], stem))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))
@@ -241,6 +318,8 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+    """``stem="s2d"`` selects the space-to-depth stem (TPU fast path;
+    checkpoint-compatible with ``stem="std"`` in both directions)."""
     if pretrained:
         raise RuntimeError(
             "pretrained weights are not bundled (no network); load a "

@@ -171,7 +171,17 @@ def sharding_rules(cfg: Optional[LlamaConfig] = None) -> ShardingRules:
     one-hot matmul psums over tp; lm_head columns over tp (vocab-
     parallel logits). With MoE the expert banks gain a leading E dim
     sharded over ep (expert parallelism) while keeping the same
-    fsdp/tp layout per expert."""
+    fsdp/tp layout per expert.
+
+    TODO(pp): there is deliberately no ``pp`` axis here yet. GPipe
+    microbatching exists and is differentiable+tested standalone
+    (``parallel/pipeline.py``, test_parallel), but on the ≤8-device
+    meshes this repo can measure, fsdp×tp (+sp/ep) dominates a
+    pipeline that idles (stages-1)/(stages-1+microbatches) of the
+    chips, so the flagship composition is parked until a topology that
+    needs it (cross-host meshes where pp's point-to-point beats fsdp's
+    all-gather). Owned by the parity-shim row in COMPONENTS.md — keep
+    these two in sync when the composition lands."""
     L = None  # leading layer axis of scanned params: never sharded
     moe = bool(cfg and cfg.moe_experts)
     ffn_up = (P(L, "ep", "fsdp", "tp") if moe else P(L, "fsdp", "tp"))

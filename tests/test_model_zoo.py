@@ -57,6 +57,32 @@ def test_resnet_hybridize_and_save_load(tmp_path):
                                atol=1e-5)
 
 
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2"])
+def test_resnet_s2d_stem_checkpoint_compatible(name, tmp_path):
+    """stem='s2d' is the exact space-to-depth rewrite of the standard
+    stem with the SAME (O, C, 7, 7) weight under the same structural
+    name: a standard-stem checkpoint loads into an s2d net (and back)
+    with matching logits — the model-zoo half of the ISSUE 3 tentpole."""
+    net, x, y0 = _check(name, 64)
+    f = str(tmp_path / "std.params")
+    net.save_parameters(f)
+
+    s2d = vision.get_model(name, classes=10, stem="s2d")
+    s2d.load_parameters(f)
+    s2d.hybridize()
+    y1 = s2d(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=2e-4,
+                               atol=2e-4)
+
+    # reverse direction: an s2d checkpoint restores a standard net
+    f2 = str(tmp_path / "s2d.params")
+    s2d.save_parameters(f2)
+    back = vision.get_model(name, classes=10)
+    back.load_parameters(f2)
+    np.testing.assert_allclose(y0.asnumpy(), back(x).asnumpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_bottleneck_resnet50_builds():
     # structural check only (no 224 forward): param shapes after a tiny
     # forward through the first stage would still cost a full forward, so
