@@ -200,6 +200,71 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256, reps=3,
     return batch * new_tokens / dt
 
 
+def bench_llama_serve(n_requests=48, max_slots=16, max_len=768,
+                      mean_interarrival_steps=4.0, seed=0, int8=False,
+                      cfg=None):
+    """Continuous-batching serving throughput + per-token latency
+    (ISSUE 4 tentpole): the same ~500M decode config served through
+    ``mxtpu.serve.ServeEngine`` under a SEEDED Poisson arrival stream
+    of mixed prompt/output lengths — the regime where whole-batch
+    ``generate`` drains to its stragglers and the slot engine keeps
+    the decode program at full batch. Reports tok/s over generated
+    tokens plus p50/p99 per-token latency (inter-token gaps)."""
+    from mxtpu.models import llama
+    from mxtpu.serve import Request, ServeEngine
+
+    cfg = cfg or llama.LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, hidden_dim=5632, max_seq_len=max_len,
+        remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    if int8:
+        params = llama.quantize_params_int8(cfg, params)
+    rng = np.random.default_rng(seed)
+    engine = ServeEngine(cfg, params, max_slots=max_slots,
+                         max_len=max_len,
+                         min_bucket=max(4, max_len // 12))
+    # warmup: compile every prefill bucket the stream will use plus
+    # the decode program BEFORE the timed region (the other benches'
+    # 'compile + drain' discipline) — otherwise tok/s and the p99
+    # inter-token gap are dominated by compile stalls
+    for j, plen in enumerate([max_len // 12, max_len // 6,
+                              max_len // 3, max_len // 2]):
+        engine.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, plen),
+            max_new_tokens=2, seed=j))
+    engine.run()
+    engine.token_log.clear()
+    engine.steps_run = 0
+    arrival = 0.0
+    total_new = 0
+    for _ in range(n_requests):
+        # mixed lengths scaled off max_len (768 default: prompts
+        # 64-384, outputs 8-256); prompt + output always fits
+        plen = int(rng.choice([max_len // 12, max_len // 6,
+                               max_len // 3, max_len // 2]))
+        mnew = int(rng.integers(8, max_len // 3 + 1))
+        total_new += mnew
+        engine.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, plen),
+            max_new_tokens=mnew, arrival_step=int(arrival)))
+        arrival += rng.exponential(mean_interarrival_steps)
+    t0 = time.perf_counter()
+    engine.run()
+    dt = time.perf_counter() - t0
+    lat = engine.latency_stats()
+    return {"metric": "llama_500m_serve_tokens_per_s"
+                      + ("_int8" if int8 else ""),
+            "value": round(total_new / dt, 1), "unit": "tok/s",
+            "p50_token_ms": round(lat["p50_token_ms"], 2),
+            "p99_token_ms": round(lat["p99_token_ms"], 2),
+            "n_requests": n_requests, "max_slots": max_slots,
+            "steps": engine.steps_run,
+            "compiles": engine.compile_count,
+            "buckets": engine.n_buckets,
+            "total_s": round(dt, 1), "vs_baseline": None}
+
+
 def _on_cpu_mesh(impl_fn_name: str, n: int = 8):
     """Run ``bench.<impl_fn_name>()`` on an n-device virtual CPU mesh:
     directly when this process already is one, else via re-exec (same
@@ -616,6 +681,29 @@ def _gate_llama():
             "unit": "tok/s", "batch": 4}
 
 
+def _gate_llama_decode(int8=False):
+    """Decode tok/s, gated (ISSUE 4 satellite: BENCH_r05 showed decode
+    reporting vs_baseline: null — a decode regression could land
+    silently). step_ms is the whole timed generate call (batch 32 ×
+    256 new tokens)."""
+    d_s = bench_llama_decode(int8=int8)
+    return {"step_ms": round(32 * 256 / d_s * 1000, 2),
+            "throughput": round(d_s, 1), "unit": "tok/s", "batch": 32}
+
+
+def _gate_llama_serve():
+    """Continuous-batching serve: step_ms is the mean decode-step
+    wall time under the seeded Poisson stream; throughput/latency ride
+    along for the BENCH record."""
+    rec = bench_llama_serve()
+    return {"step_ms": round(1000.0 * rec["total_s"]
+                             / max(rec["steps"], 1), 2),
+            "throughput": rec["value"], "unit": "tok/s",
+            "p50_token_ms": rec["p50_token_ms"],
+            "p99_token_ms": rec["p99_token_ms"],
+            "batch": rec["max_slots"]}
+
+
 def _gate_smoke_llama():
     """CPU-safe tiny config — exercises the same measurement path so
     the gate plumbing is testable without a chip. Batch 8 so the dp
@@ -633,6 +721,9 @@ GATE_CONFIGS = {
     "resnet50_s2d": lambda: _gate_resnet("s2d"),
     "bert_base": _gate_bert,
     "llama_509m": _gate_llama,
+    "llama_509m_decode": _gate_llama_decode,
+    "llama_509m_decode_int8": lambda: _gate_llama_decode(int8=True),
+    "llama_509m_serve": _gate_llama_serve,
     "smoke_llama": _gate_smoke_llama,
 }
 
@@ -733,7 +824,9 @@ def main_gate(argv):
         raise SystemExit(f"no baseline at {args.baseline}; run with "
                          f"--update on a chip box first")
 
-    flagship = ["resnet50", "resnet50_s2d", "bert_base", "llama_509m"]
+    flagship = ["resnet50", "resnet50_s2d", "bert_base", "llama_509m",
+                "llama_509m_decode", "llama_509m_decode_int8",
+                "llama_509m_serve"]
     if args.replay:
         with open(args.replay) as f:
             current = json.load(f)["configs"]
@@ -786,11 +879,15 @@ def main():
         raise SystemExit(main_gate(sys.argv[2:]))
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
     if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b",
-                    "aot8b_decode", "aot_moe", "aot8b_int8", "aot8b_32k", "input"):
+                    "aot8b_decode", "aot_moe", "aot8b_int8", "aot8b_32k",
+                    "input", "serve"):
         raise SystemExit(
             "usage: bench.py [all|resnet|bert|llama|smoke|aot8b|"
-            "aot8b_decode|aot_moe|aot8b_int8|aot8b_32k|input|"
+            "aot8b_decode|aot_moe|aot8b_int8|aot8b_32k|input|serve|"
             f"gate ...] (got {only!r})")
+    if only == "serve":
+        print(json.dumps(bench_llama_serve()))
+        return
     if only == "smoke":
         print(json.dumps(bench_smoke_run()))
         return
@@ -847,6 +944,7 @@ def main():
         extras.append({"metric": "llama_500m_decode_int8_tokens_per_s",
                        "value": round(q_s, 1), "unit": "tok/s",
                        "vs_baseline": None})
+        extras.append(bench_llama_serve())
     if only == "all":
         extras.append(bench_input_pipeline())
     out = {

@@ -25,6 +25,16 @@ Rules (stable IDs, see docs/lint.md):
   optimizer/ndarray ops inside a ``step``/``update`` path — the
   ~150-dispatches-per-step pattern ``Trainer.make_fused_step`` exists
   to kill.
+- ``MXL004`` serving-latency: a host synchronization (``.item()``,
+  ``float()``/``int()`` on a tensor, ``np.asarray``,
+  ``.block_until_ready()``, ``jax.device_get``, ``.asnumpy()``)
+  inside a decode/generate loop body — the classic serving-latency
+  bug: the host blocks on every token and the accelerator pipeline
+  drains. Flagged when the loop's enclosing function is decode/
+  generate/serve-named OR the loop body itself dispatches a
+  decode/generate call. Fix: read tokens back one step late so the
+  sync overlaps the next step's compute (the ``mxtpu.serve`` engine's
+  pattern — docs/serving.md), or batch the readback after the loop.
 
 Suppression: append ``# mxlint: disable=MXL001`` (comma-separate for
 several IDs, or ``disable=all``) to the flagged line, or put the comment
@@ -49,6 +59,9 @@ RULES: Dict[str, str] = {
               "inside hybrid_forward (breaks hybridize()/jit)",
     "MXL003": "dispatch-count: per-parameter Python op loop in a "
               "step/update path (use Trainer.make_fused_step)",
+    "MXL004": "serving-latency: host sync inside a decode/generate "
+              "loop body (overlap or batch the readback — "
+              "docs/serving.md)",
     "MXL100": "graph-validity: Symbol graph fails static shape/dtype "
               "inference (see mxtpu.contrib.analysis.validate_graph)",
 }
@@ -409,6 +422,107 @@ def _rule_dispatch_count(tree: ast.AST, path: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# MXL004 — host syncs inside decode/generate loops
+# ---------------------------------------------------------------------------
+# function names that mark a serving/decoding context on their own
+# (anchored at a word/underscore start: "imdecode" — the image codec —
+# must not qualify)
+_SERVE_FN_RE = re.compile(r"(?:^|_)(decode|generate|serve)",
+                          re.IGNORECASE)
+# callee last-segments that mark a loop body as a decode loop; the
+# caller additionally requires >= 2 call arguments so ``bytes
+# .decode()`` / ``s.decode("utf-8")`` never qualify
+_DECODE_CALL_RE = re.compile(r"(?:^|_)(decode|generate)",
+                             re.IGNORECASE)
+# method calls that force a device->host sync on their receiver
+_SYNC_ATTRS = {"item", "block_until_ready", "asnumpy"}
+# host-numpy entry points that force a sync on a device-array argument
+_HOST_NP_FUNCS = {"asarray", "array"}
+
+
+def _sync_call_desc(node: ast.Call, aliases: Dict[str, str],
+                    weak: bool) -> Optional[str]:
+    """A short description of why this call is a host sync, or None.
+    ``weak`` additionally counts ``float()``/``int()`` on a
+    non-constant — only safe to assume tensor-ish when the loop
+    provably dispatches decode/generate (the colocation context); in
+    the name-only context they are far more often host-value parses."""
+    chain = _dotted_chain(node.func)
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _SYNC_ATTRS:
+        return f".{node.func.attr}()"
+    if weak and chain is not None and len(chain) == 1 and \
+            chain[0] in ("float", "int") and len(node.args) == 1 and \
+            not isinstance(node.args[0], ast.Constant):
+        return f"{chain[0]}(...)"
+    if chain is None:
+        return None
+    if chain[-1] == "device_get":
+        return ".".join(chain) + "(...)"
+    module = _expand_callee_module(chain, aliases)
+    if module is not None and chain[-1] in _HOST_NP_FUNCS and \
+            "numpy" in module.split(".") and \
+            module.split(".")[0] != "jax":
+        return ".".join(chain) + "(...)"
+    return None
+
+
+def _loop_calls_decode(loop: ast.AST) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            chain = _dotted_chain(node.func)
+            if chain is not None and \
+                    _DECODE_CALL_RE.search(chain[-1]) and \
+                    len(node.args) + len(node.keywords) >= 2:
+                return True
+    return False
+
+
+def _rule_serving_sync(tree: ast.AST, aliases: Dict[str, str],
+                       path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    flagged: Set[int] = set()
+
+    def scan_loops(scope: ast.AST, fn_name: str) -> None:
+        for loop in ast.walk(scope):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            colocated = _loop_calls_decode(loop)
+            in_context = colocated or \
+                bool(_SERVE_FN_RE.search(fn_name))
+            if not in_context:
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or \
+                        id(node) in flagged:
+                    continue
+                desc = _sync_call_desc(node, aliases, weak=colocated)
+                if desc is None:
+                    continue
+                flagged.add(id(node))
+                findings.append(Finding(
+                    "MXL004", path, node.lineno, node.col_offset,
+                    f"host sync {desc} inside a decode/generate loop "
+                    f"body blocks the accelerator every iteration; "
+                    f"read results back one step late (overlap) or "
+                    f"batch the readback after the loop "
+                    f"(docs/serving.md)"))
+
+    # loops inside functions carry the function's name as context;
+    # module-level loops qualify only via the decode-call heuristic
+    covered: Set[int] = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_loops(fn, fn.name)
+            for n in ast.walk(fn):
+                covered.add(id(n))
+    for node in ast.iter_child_nodes(tree):
+        if id(node) not in covered:
+            scan_loops(node, "")
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 def lint_source(source: str, path: str = "<string>",
@@ -425,6 +539,7 @@ def lint_source(source: str, path: str = "<string>",
     findings += _rule_trace_safety(tree, aliases, path)
     findings += _rule_tracer_flow(tree, path)
     findings += _rule_dispatch_count(tree, path)
+    findings += _rule_serving_sync(tree, aliases, path)
     if rules is not None:
         wanted = {r.upper() for r in rules}
         findings = [f for f in findings if f.rule in wanted]

@@ -186,3 +186,16 @@ class GluonLlama(HybridBlock):
         out = _fl.generate(self._cfg, self.as_pytree(), tok,
                            max_new_tokens, **kw)
         return NDArray(out)
+
+    def serve(self, **kw):
+        """A continuous-batching :class:`mxtpu.serve.ServeEngine` over
+        the live weights (docs/serving.md): requests join and leave
+        the running batch at step boundaries instead of the whole-
+        batch ``generate`` loop. On a sharded net the slot cache and
+        decode run on the params' mesh. The engine holds the weight
+        pytree by reference — a fused train step DONATES the buffers,
+        so build a fresh engine after training steps rather than
+        serving across them."""
+        from ...serve import ServeEngine
+        kw.setdefault("mesh", getattr(self, "_mesh", None))
+        return ServeEngine(self._cfg, self.as_pytree(), **kw)

@@ -35,7 +35,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import (flash_attention, dense_attention,
-                             ring_attention, ulysses_attention)
+                             ring_attention, ulysses_attention,
+                             slot_decode_attention)
 from ..parallel.sharding import ShardingRules, constrain
 from ..parallel.sharding import mcon as _mcon
 
@@ -43,7 +44,9 @@ __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "loss_fn", "chunked_softmax_xent", "sharding_rules",
            "CONFIGS", "init_cache", "cache_specs", "prefill",
            "chunked_prefill", "decode_step", "generate",
-           "quantize_params_int8", "int8_sharding_rules"]
+           "quantize_params_int8", "int8_sharding_rules",
+           "sample_logits", "init_slot_cache", "slot_cache_specs",
+           "prefill_slot", "decode_slots"]
 
 
 @dataclass(frozen=True)
@@ -666,11 +669,15 @@ def _layer_cached(cfg: LlamaConfig, cos, sin, pos, max_len,
 
 def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
                     last_only: bool = False,
-                    mesh: Optional[Mesh] = None):
+                    mesh: Optional[Mesh] = None,
+                    last_index=None):
     """Shared prefill/decode body: runs the stack over the cache and
     returns (logits (b, s, V) f32, new cache). ``last_only`` applies
     the lm_head to the final position only — generation never needs
-    (and must not pay for) full-prompt logits. ``mesh`` pins the cache
+    (and must not pay for) full-prompt logits. ``last_index`` (a traced
+    scalar) instead applies it to that single position — the bucketed
+    serving prefill pads prompts to a bucket, so "last" is the last
+    REAL position, not the last row. ``mesh`` pins the cache
     and residual-stream shardings (see ``cache_specs``); params attend
     against the cache in their training placement, so the tp einsums
     stay local and XLA reduces over tp exactly where the Megatron
@@ -714,7 +721,9 @@ def _forward_cached(cfg: LlamaConfig, params, tokens, cache,
         ck = lax.with_sharding_constraint(ck, full)
         cv = lax.with_sharding_constraint(cv, full)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    if last_only:
+    if last_index is not None:
+        x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+    elif last_only:
         x = x[:, -1:]
     hw = (_wq8(params["tok_embed"], cfg.dtype).T if cfg.tie_embeddings
           else _wq8(params["lm_head"], cfg.dtype))
@@ -796,6 +805,85 @@ def decode_step(cfg: LlamaConfig, params, token, cache,
     return logits[:, 0], cache
 
 
+def sample_logits(rng, lg, temperature=0.0, top_k=None, top_p=None):
+    """THE sampler — one shared helper for :func:`generate` and the
+    continuous-batching serving engine (``mxtpu.serve``). lg: (b, V)
+    f32 logits → (b,) int32 tokens.
+
+    Two calling modes, numerically aligned token-for-token:
+
+    - **static** (all of temperature/top_k/top_p are Python numbers or
+      None): specializes the jitted graph per config — greedy compiles
+      to a bare argmax, top-k uses ``lax.top_k`` — the fast path
+      ``generate``'s one-program decode loop wants.
+    - **traced** (any of them a jax/numpy array): one graph serves
+      every per-row mix — temperature (b,), top_k (b,) ints (vocab
+      size disables), top_p (b,) (1.0 disables), with temperature 0
+      rows selecting argmax. This is how the serving engine runs
+      requests with different sampling configs through ONE compiled
+      decode program, with tokens bit-matching the static path: the
+      top-k threshold is the same kth VALUE, the nucleus keep-mask the
+      same formula, so the masked logits agree and
+      ``jax.random.categorical`` sees identical inputs.
+
+    Nucleus semantics (both modes): keep the smallest prefix of the
+    sorted distribution whose mass reaches p — probabilities computed
+    ONCE, and the survivor set applied as a value threshold (the kept
+    minimum) rather than a full-vocab scatter."""
+    static = (isinstance(temperature, (int, float))
+              and (top_k is None or isinstance(top_k, int))
+              and (top_p is None or isinstance(top_p, (int, float))))
+    V = lg.shape[-1]
+    if static:
+        if temperature == 0.0:
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        lg = lg / temperature
+        if top_k is not None and top_k < V:
+            kth = lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        if top_p is not None and top_p < 1.0:
+            lg = _nucleus_mask(lg, top_p)
+        return jax.random.categorical(rng, lg, axis=-1) \
+            .astype(jnp.int32)
+
+    def col(x, dtype):          # broadcast a scalar or (b,) over vocab
+        x = jnp.asarray(x, dtype)
+        return x.reshape(x.shape + (1,) * (lg.ndim - x.ndim))
+
+    t_col = col(temperature, jnp.float32)
+    k_col = jnp.clip(col(V if top_k is None else top_k, jnp.int32),
+                     1, V)
+    p_col = col(1.0 if top_p is None else top_p, jnp.float32)
+
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    slg = lg / jnp.where(t_col == 0.0, 1.0, t_col)
+    # top-k as a value threshold: the kth-largest VALUE equals
+    # lax.top_k's kth element, so the mask matches the static path
+    srt = jnp.take_along_axis(slg, jnp.argsort(-slg, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(srt, jnp.broadcast_to(
+        k_col - 1, slg.shape[:-1] + (1,)), axis=-1)
+    slg = jnp.where(slg < kth, -jnp.inf, slg)
+    slg = _nucleus_mask(slg, p_col)
+    sampled = jax.random.categorical(rng, slg, axis=-1) \
+        .astype(jnp.int32)
+    return jnp.where(jnp.squeeze(t_col, -1) == 0.0, greedy, sampled)
+
+
+def _nucleus_mask(lg, top_p):
+    """Mask lg to the top-p nucleus: softmax ONCE over the sorted row,
+    keep the smallest prefix reaching p (the top token always
+    survives), and apply the survivor set as a >= threshold on the
+    kept minimum — no full-vocab scatter."""
+    order = jnp.argsort(-lg, axis=-1)
+    sorted_lg = jnp.take_along_axis(lg, order, axis=-1)
+    probs = jax.nn.softmax(sorted_lg, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (csum - probs) < top_p
+    cutoff = jnp.min(jnp.where(keep_sorted, sorted_lg, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(lg >= cutoff, lg, -jnp.inf)
+
+
 def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
              *, temperature: float = 0.0,
              top_k: Optional[int] = None,
@@ -833,28 +921,8 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
                                     last_only=True, mesh=mesh)
 
     def sample(rng, lg):
-        if temperature == 0.0:
-            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        lg = lg / temperature
-        if top_k is not None and top_k < lg.shape[-1]:
-            kth = lax.top_k(lg, top_k)[0][..., -1:]
-            lg = jnp.where(lg < kth, -jnp.inf, lg)
-        if top_p is not None and top_p < 1.0:
-            # nucleus: keep the smallest sorted prefix whose mass
-            # reaches p (the first token always survives)
-            order = jnp.argsort(-lg, axis=-1)
-            sorted_lg = jnp.take_along_axis(lg, order, axis=-1)
-            csum = jnp.cumsum(jax.nn.softmax(sorted_lg, axis=-1),
-                              axis=-1)
-            keep_sorted = (csum - jax.nn.softmax(sorted_lg, axis=-1)
-                           ) < top_p
-            keep = jnp.zeros_like(lg, jnp.bool_)
-            keep = keep.at[
-                jnp.arange(lg.shape[0])[:, None], order].set(
-                keep_sorted)
-            lg = jnp.where(keep, lg, -jnp.inf)
-        return jax.random.categorical(rng, lg, axis=-1) \
-            .astype(jnp.int32)
+        return sample_logits(rng, lg, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     rng, sub = jax.random.split(rng)
     first = sample(sub, logits[:, -1])
@@ -872,3 +940,253 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
     out = jnp.concatenate(
         [prompt, first[:, None], rest.transpose(1, 0)], axis=1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching serving: slot KV cache + one-program decode
+# (the model half of ``mxtpu.serve`` — scheduler/queue live there)
+# ---------------------------------------------------------------------------
+# ``generate`` above is a WHOLE-BATCH program: every request starts
+# together and holds its cache until the slowest one finishes. The
+# slot path instead serves a fixed bank of ``max_slots`` independent
+# rows: admission overwrites a finished slot in place (Orca-style
+# iteration-level scheduling), per-slot length/position vectors drive
+# ONE compiled decode program for the full bank, and the length-masked
+# ``slot_decode_attention`` kernel confines each slot to its own
+# prefix. Prompts prefill through per-bucket programs (padded to a
+# power of two), so total compilations stay bounded by the bucket
+# count + 1.
+
+def slot_cache_specs(cfg: LlamaConfig, mesh: Mesh):
+    """PartitionSpecs for the serving slot state on ``mesh``: kv heads
+    over tp (dropped when tp doesn't divide them — replication, never
+    an error); the slot axis stays unsharded — admission rewrites one
+    row at a time and must not reshard the bank. Per-slot vectors are
+    replicated."""
+    tp = ("tp" if "tp" in mesh.axis_names
+          and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None)
+    # trailing Nones trimmed: program outputs come back normalized, and
+    # a committed P(..., 'tp', None, None) vs an output P(..., 'tp')
+    # would be unequal jit cache keys — one spurious recompile per
+    # program on the mesh path
+    kv = P(None, None, tp) if tp is not None else P()
+    return {"k": kv, "v": kv, "lengths": P(), "tokens": P(),
+            "rngs": P()}
+
+
+def init_slot_cache(cfg: LlamaConfig, max_slots: int, max_len: int,
+                    mesh: Optional[Mesh] = None):
+    """The serving engine's device state: a fixed slot KV cache
+    ``k``/``v`` of (L, max_slots, n_kv_heads, max_len, hd) in the
+    compute dtype, plus per-slot ``lengths`` (valid cache entries),
+    ``tokens`` (next input token) and ``rngs`` (per-request sampling
+    chains). With ``mesh`` the bank materializes directly sharded per
+    :func:`slot_cache_specs`."""
+    hd = cfg.head_dim
+    shape = (cfg.n_layers, max_slots, cfg.n_kv_heads, max_len, hd)
+
+    def build():
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype),
+                "lengths": jnp.zeros((max_slots,), jnp.int32),
+                "tokens": jnp.zeros((max_slots,), jnp.int32),
+                "rngs": jnp.zeros((max_slots, 2), jnp.uint32)}
+
+    if mesh is None:
+        return build()
+    from jax.sharding import NamedSharding
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        slot_cache_specs(cfg, mesh),
+        is_leaf=lambda s: isinstance(s, P))
+    return jax.jit(build, out_shardings=shardings)()
+
+
+def _layer_slots(cfg: LlamaConfig, cos, sin, pos, mesh, kvspec,
+                 x, lp, ck, cv):
+    """One block of the slot decode: x (S, 1, dim) — one new token per
+    slot; ck/cv (S, kvh, max_len, hd). Writes each slot's new K/V at
+    its OWN position ``pos[i]`` and attends it against its own prefix
+    via the length-masked blockwise kernel."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ _wq8(lp["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ _wq8(lp["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ _wq8(lp["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = q.transpose(0, 2, 1, 3)          # (S, h, 1, hd)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    head_ax = (kvspec[1] if kvspec is not None and len(kvspec) > 1
+               else None)
+    q = _mcon(mesh, q, None, head_ax, None, None)
+    k = _mcon(mesh, k, None, head_ax, None, None)
+    v = _mcon(mesh, v, None, head_ax, None, None)
+
+    zero = jnp.zeros((), jnp.int32)
+
+    def write(c, u, p):          # per-slot scatter at its own position
+        return lax.dynamic_update_slice(c, u, (zero, p, zero))
+
+    ck = jax.vmap(write)(ck, k.astype(dt), pos)
+    cv = jax.vmap(write)(cv, v.astype(dt), pos)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        ck = lax.with_sharding_constraint(
+            ck, NamedSharding(mesh, kvspec))
+        cv = lax.with_sharding_constraint(
+            cv, NamedSharding(mesh, kvspec))
+
+    o = slot_decode_attention(q, ck, cv, pos + 1)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    x = x + _mcon(mesh, o @ _wq8(lp["wo"], dt), None, None, None)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    delta, _ = _ffn(cfg, lp, h, mesh, serving=True)
+    x = x + _mcon(mesh, delta, None, None, None)
+    return x, ck, cv
+
+
+def decode_slots(cfg: LlamaConfig, params, kv, sv, active,
+                 temperature, top_k, top_p,
+                 mesh: Optional[Mesh] = None):
+    """ONE continuous-batching decode step over the whole slot bank —
+    the single compiled program the serving engine keeps hot: per-slot
+    position/length arrays drive the RoPE gather, the cache write and
+    the length-masked attention, so requests entering and leaving the
+    bank never change the program shape (no retraces, ever).
+
+    kv: {"k", "v"} — the big cache bank, safe to DONATE (the engine
+    does). sv: {"lengths", "tokens", "rngs"} — the small per-slot
+    vectors, deliberately NOT donated so the engine can overlap the
+    host read of one step's tokens with the next step's dispatch.
+    active: (S,) bool — inactive slots still flow through (fixed
+    shape) but their lengths do not advance and their samples are
+    discarded by the engine. temperature/top_k/top_p: (S,) per-slot
+    sampling config (traced — a mixed batch shares the program).
+    Sampling advances each slot's own rng chain exactly as a batch-1
+    :func:`generate` would, which is what makes serving output
+    bit-identical to per-request generation. Returns
+    (sampled (S,) int32, new kv, new sv)."""
+    max_len = kv["k"].shape[3]
+    lengths = sv["lengths"].astype(jnp.int32)
+    pos = jnp.minimum(lengths, max_len - 1)   # per-slot write position
+    tokens = sv["tokens"][:, None]
+    emb = params["tok_embed"]
+    if isinstance(emb, dict):
+        x = emb["q8"][tokens].astype(cfg.dtype) * \
+            emb["s8"][0].astype(cfg.dtype)
+    else:
+        x = emb[tokens].astype(cfg.dtype)
+
+    kvspec = None
+    if mesh is not None:
+        kvspec = P(*tuple(slot_cache_specs(cfg, mesh)["k"])[1:])
+    cos_t, sin_t = rope_tables(cfg, max_len)
+    cos = cos_t[pos][:, None, None, :]        # (S, 1, 1, hd/2)
+    sin = sin_t[pos][:, None, None, :]
+
+    def body(x, xs):
+        lp, ck, cv = xs
+        x, ck, cv = _layer_slots(cfg, cos, sin, pos, mesh, kvspec,
+                                 x, lp, ck, cv)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x,
+                           (params["layers"], kv["k"], kv["v"]))
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        full = NamedSharding(mesh, slot_cache_specs(cfg, mesh)["k"])
+        ck = lax.with_sharding_constraint(ck, full)
+        cv = lax.with_sharding_constraint(cv, full)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    hw = (_wq8(params["tok_embed"], cfg.dtype).T if cfg.tie_embeddings
+          else _wq8(params["lm_head"], cfg.dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, hw,
+                        preferred_element_type=jnp.float32)[:, 0]
+
+    def one(key, lg, t, kk, pp):
+        # mirror generate's step: split the chain, sample on (1, V)
+        key, sub = jax.random.split(key)
+        tok = sample_logits(sub, lg[None], temperature=t,
+                            top_k=kk, top_p=pp)[0]
+        return key, tok
+
+    new_rngs, sampled = jax.vmap(one)(
+        sv["rngs"], logits, temperature, top_k, top_p)
+    new_lengths = lengths + active.astype(jnp.int32)
+    if mesh is not None:
+        # pin the small vectors replicated — an unconstrained output
+        # sharding would differ from the bank's committed layout and
+        # force a second decode compilation on the next step
+        sampled = _mcon(mesh, sampled, None)
+        new_lengths = _mcon(mesh, new_lengths, None)
+        new_rngs = _mcon(mesh, new_rngs, None, None)
+    return sampled, {"k": ck, "v": cv}, \
+        {"lengths": new_lengths, "tokens": sampled, "rngs": new_rngs}
+
+
+def prefill_slot(cfg: LlamaConfig, params, tokens, true_len, slot,
+                 kv, sv, rng, temperature, top_k, top_p,
+                 mesh: Optional[Mesh] = None):
+    """Admission: run ONE request's prompt — END-padded to its bucket —
+    through the cached stack, write its K/V into row ``slot`` of the
+    slot bank, seed the slot's rng/next-token, and sample the first
+    generated token. One compiled program per prompt BUCKET (power of
+    two), so compilations are bounded by the bucket count no matter
+    what lengths arrive.
+
+    End padding is exact: causal masking means no real position ever
+    attends a pad (pads sit after the prompt), pad K/V beyond
+    ``true_len`` are excluded by the slot's length mask, and each is
+    overwritten by a real decode write before the length ever reaches
+    it. tokens: (1, bucket); true_len/slot: traced scalars; kv/sv as
+    in :func:`decode_slots` (kv donatable). Returns
+    (first token (1,), new kv, new sv)."""
+    b, bucket = tokens.shape
+    hd = cfg.head_dim
+    tmp = {"k": jnp.zeros((cfg.n_layers, b, cfg.n_kv_heads, bucket,
+                           hd), cfg.dtype),
+           "v": jnp.zeros((cfg.n_layers, b, cfg.n_kv_heads, bucket,
+                           hd), cfg.dtype),
+           "pos": jnp.zeros((), jnp.int32)}
+    true_len = jnp.asarray(true_len, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    logits, tmp = _forward_cached(cfg, params, tokens, tmp, mesh=mesh,
+                                  last_index=true_len - 1)
+    rng, sub = jax.random.split(rng)
+    tok = sample_logits(sub, logits[:, 0], temperature=temperature,
+                        top_k=top_k, top_p=top_p)
+    z = jnp.zeros((), jnp.int32)
+    new_kv = {
+        "k": lax.dynamic_update_slice(kv["k"], tmp["k"],
+                                      (z, slot, z, z, z)),
+        "v": lax.dynamic_update_slice(kv["v"], tmp["v"],
+                                      (z, slot, z, z, z)),
+    }
+    new_sv = {
+        "lengths": lax.dynamic_update_slice(
+            sv["lengths"].astype(jnp.int32), true_len[None],
+            (slot,)),
+        "tokens": lax.dynamic_update_slice(
+            sv["tokens"], tok.astype(sv["tokens"].dtype),
+            (slot,)),
+        "rngs": lax.dynamic_update_slice(
+            sv["rngs"], rng[None].astype(sv["rngs"].dtype),
+            (slot, z)),
+    }
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        specs = slot_cache_specs(cfg, mesh)
+        new_kv = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n]))
+            for n, a in new_kv.items()}
+        new_sv = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n]))
+            for n, a in new_sv.items()}
+        tok = _mcon(mesh, tok, None)
+    return tok, new_kv, new_sv

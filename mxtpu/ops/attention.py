@@ -24,7 +24,7 @@ from jax import lax
 
 __all__ = ["dense_attention", "blockwise_attention", "flash_attention",
            "ulysses_attention",
-           "ring_attention"]
+           "ring_attention", "slot_decode_attention"]
 
 _NEG_INF = -1e30  # finite "minus infinity": keeps fully-masked rows NaN-free
 
@@ -190,6 +190,73 @@ def flash_attention(q, k, v, *, causal: bool = False,
                 pass
     return blockwise_attention(q, kr, vr, causal=causal, scale=scale,
                                kv_block=kv_block)
+
+
+def slot_decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
+                          kv_block: int = 512):
+    """Length-masked decode attention over a SLOT KV cache — the
+    serving engine's kernel (``mxtpu.serve``): each slot holds an
+    independent request whose cache row is valid only up to its own
+    ``lengths[i]``, so one fixed-shape program serves a ragged batch.
+
+    q: (slots, n_heads, s, hd) — the new token(s), s is 1 in decode.
+    k, v: (slots, n_kv_heads, max_len, hd) — the per-layer slot cache
+    (GQA: ``n_heads % n_kv_heads == 0``; queries are grouped per kv
+    head, the cache is never repeated).
+    lengths: (slots,) int — slot i attends keys ``[0, lengths[i])``.
+
+    Blockwise flash-style online softmax over ``kv_block``-wide KV
+    slices: the (s, max_len) score matrix is never materialized — only
+    one (slots, groups, rep, s, kv_block) block of scores lives at a
+    time, with running (max, denom, numerator) carries. Fully-masked
+    rows (lengths == 0) come out as zeros, matching ``dense_attention``
+    masked-softmax semantics."""
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    if hq % hkv:
+        raise ValueError(f"{hq} q heads not divisible by {hkv} kv heads")
+    rep = hq // hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    max_len = k.shape[2]
+    lengths = lengths.astype(jnp.int32)
+    kv_block = min(kv_block, max_len)
+    nblk, remv = divmod(max_len, kv_block)
+    if remv:  # pad the cache tail; padded keys are masked by position
+        pad = kv_block - remv
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        nblk += 1
+
+    qg = q.reshape(b, hkv, rep, sq, d)
+    kb = k.reshape(b, hkv, nblk, kv_block, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, hkv, nblk, kv_block, d).transpose(2, 0, 1, 3, 4)
+
+    m0 = jnp.full((b, hkv, rep, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, rep, sq), jnp.float32)
+    o0 = jnp.zeros((b, hkv, rep, sq, d), jnp.float32)
+
+    def body(carry, xs):
+        m, l, o = carry
+        i, kblk, vblk = xs
+        scores = jnp.einsum("bgrsd,bgkd->bgrsk", qg, kblk,
+                            preferred_element_type=jnp.float32) * scale
+        kpos = i * kv_block + jnp.arange(kv_block)       # (kv_block,)
+        allowed = kpos[None, :] < lengths[:, None]       # (b, kv_block)
+        allowed = allowed[:, None, None, None, :]
+        scores = jnp.where(allowed, scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(allowed, p, 0.0)   # length-0 slots stay all-zero
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bgrsk,bgkd->bgrsd", p, vblk.astype(jnp.float32))
+        return (m_new, l_new, o_new), None
+
+    (m, l, o), _ = lax.scan(body, (m0, l0, o0),
+                            (jnp.arange(nblk), kb, vb))
+    out = _finalize(m, l, o, q.dtype)
+    return out.reshape(b, hq, sq, d)
 
 
 def ring_attention(q, k, v, *, axis_name: str = "sp",

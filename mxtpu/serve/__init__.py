@@ -1,0 +1,21 @@
+"""Continuous-batching LLM serving (docs/serving.md).
+
+The north star demands a system that "serves heavy traffic from
+millions of users"; ``mxtpu.models.llama.generate`` is a whole-batch
+program — every request starts together, decodes to the same length,
+and the batch drains to its stragglers. This package is the Orca-style
+fix (iteration-level scheduling over a slot KV cache): requests join
+and leave the running batch at step boundaries, the decode program
+stays hot at full batch, and total compilations are bounded by the
+prefill-bucket count + 1.
+
+    from mxtpu.serve import ServeEngine, Request
+    eng = ServeEngine(cfg, params, max_slots=8, max_len=256)
+    rid = eng.submit(Request(prompt, max_new_tokens=32))
+    results = eng.run()          # {rid: np.ndarray of generated tokens}
+
+Or from the Gluon surface: ``net.serve(...)`` on a ``GluonLlama``.
+"""
+from .engine import Request, ServeEngine, bucket_for
+
+__all__ = ["Request", "ServeEngine", "bucket_for"]

@@ -1,0 +1,319 @@
+"""ServeEngine — continuous batching over the llama slot KV cache.
+
+Scheduler design (Orca, OSDI '22; slot-structured cache in the spirit
+of vLLM's paged KV, SOSP '23 — one fixed bank, no paging, because XLA
+wants static shapes):
+
+- **slot bank**: ``llama.init_slot_cache`` holds ``max_slots``
+  independent cache rows; per-slot ``lengths`` confine attention to
+  each request's own prefix (``slot_decode_attention``).
+- **admission at step boundaries**: a finished slot is overwritten in
+  place by the next queued request via a per-BUCKET prefill program
+  (prompts end-padded to a power of two — exact, see
+  ``llama.prefill_slot``), so prefill compilations are bounded by the
+  bucket count.
+- **one decode program**: every step runs ``llama.decode_slots`` over
+  the full bank; per-slot position/length/rng/sampling vectors make
+  request churn invisible to the compiled shape. The engine asserts
+  this via :attr:`compile_count`.
+- **overlapped host sync**: the classic serving-latency bug is a host
+  readback inside the decode loop blocking the accelerator every token
+  (mxlint MXL004 flags the pattern). Here step ``t``'s tokens are read
+  back only AFTER step ``t+1`` has been dispatched, so the sync runs
+  under the next step's device time (``MXTPU_SERVE_OVERLAP=0`` forces
+  the naive synchronous order, e.g. for latency debugging).
+
+Determinism contract: each slot's forward and sampling depend only on
+its own cache row and rng chain, so the engine's output for a request
+never depends on how requests are interleaved, admitted, or delayed
+(tested across slot counts and overlap modes). Against per-request
+``llama.generate`` the math is identical and the rng chain replays
+exactly; tokens are bit-identical in f32 (the tier-1 acceptance gate).
+In reduced precision (bf16) the two attention formulations round
+differently (the slot kernel accumulates in f32; the scalar-pos path
+casts probs to the compute dtype), so a near-tie token can differ —
+batch-size-invariance, not cross-kernel bit-equality, is the contract
+there.
+"""
+from __future__ import annotations
+
+import heapq
+import os
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..models import llama
+
+__all__ = ["Request", "ServeEngine", "bucket_for"]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def bucket_for(length: int, min_bucket: int, max_len: int) -> int:
+    """Prefill bucket policy: the smallest power of two >= ``length``
+    (floored at ``min_bucket``, capped at ``max_len``). Compilations
+    are bounded by the bucket count: log2(max_len / min_bucket) + 1
+    programs cover every prompt length."""
+    if length > max_len:
+        raise ValueError(f"prompt length {length} > max_len {max_len}")
+    b = max(1, min_bucket)
+    while b < length:
+        b *= 2
+    return min(b, max_len)
+
+
+@dataclass
+class Request:
+    """One generation request. ``temperature=0`` is greedy; ``seed``
+    starts the request's OWN rng chain (the one ``generate`` would use
+    as ``rng=PRNGKey(seed)``). ``arrival_step`` delays admission until
+    that engine step — the hook seeded arrival streams (bench, tests)
+    use. ``on_token(rid, token)`` streams tokens as they are
+    produced."""
+    prompt: Any
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
+    arrival_step: int = 0
+    on_token: Optional[Callable[[int, int], None]] = None
+
+
+@dataclass
+class _Dispatch:
+    """One in-flight decode step: the device handle plus the host-side
+    snapshot needed to attribute its tokens after the overlapped
+    sync."""
+    sampled: Any                                   # device (S,) int32
+    slots: List[Tuple[int, int]]                   # (slot, rid) active
+    firsts: List[Tuple[int, Any]]                  # (rid, device (1,))
+
+
+class ServeEngine:
+    """Continuous-batching scheduler over one model + one slot bank.
+
+    Args: ``cfg``/``params`` — a llama config and parameter pytree
+    (the weight-only int8 tree from ``quantize_params_int8`` rides the
+    same programs). ``max_slots``/``max_len``/``min_bucket`` default
+    from ``MXTPU_SERVE_MAX_SLOTS`` / the config's ``max_seq_len`` /
+    ``MXTPU_SERVE_MIN_BUCKET``. ``mesh`` serves sharded (cache per
+    ``llama.slot_cache_specs``, params as placed by the training
+    rules)."""
+
+    def __init__(self, cfg, params, *, max_slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 min_bucket: Optional[int] = None,
+                 mesh=None, overlap: Optional[bool] = None):
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.max_slots = (max_slots if max_slots is not None
+                          else _env_int("MXTPU_SERVE_MAX_SLOTS", 8))
+        self.max_len = int(max_len or cfg.max_seq_len)
+        self.min_bucket = (min_bucket if min_bucket is not None
+                           else _env_int("MXTPU_SERVE_MIN_BUCKET", 16))
+        self.overlap = (os.environ.get("MXTPU_SERVE_OVERLAP", "1")
+                        != "0") if overlap is None else bool(overlap)
+
+        state = llama.init_slot_cache(cfg, self.max_slots,
+                                      self.max_len, mesh=mesh)
+        self._kv = {"k": state["k"], "v": state["v"]}
+        self._sv = {n: state[n] for n in ("lengths", "tokens", "rngs")}
+        # the kv bank is donated through every program (in-place in
+        # HBM); the small vectors are not, so the previous step's
+        # sampled tokens stay readable during the overlapped sync
+        self._decode = jax.jit(
+            partial(llama.decode_slots, cfg, mesh=mesh),
+            donate_argnums=(1,))
+        self._prefills: Dict[int, Any] = {}
+
+        S = self.max_slots
+        self._active = np.zeros(S, bool)
+        self._temps = np.zeros(S, np.float32)
+        self._topks = np.full(S, cfg.vocab_size, np.int32)
+        self._topps = np.ones(S, np.float32)
+        self._slot_rid: List[Optional[int]] = [None] * S
+
+        self._queue: List[Tuple[int, int, Request]] = []   # heap
+        self._requests: Dict[int, Request] = {}
+        self._results: Dict[int, List[int]] = {}
+        self._done: Dict[int, bool] = {}
+        self._next_rid = 0
+        self._step_idx = 0
+        self.steps_run = 0
+        self.token_log: List[Tuple[int, int, float]] = []
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, request: Request) -> int:
+        """Queue a request; returns its id. Validation mirrors
+        ``generate``'s."""
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got "
+                f"{request.max_new_tokens}")
+        if prompt.size + request.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_len "
+                f"{self.max_len}")
+        if request.top_k is not None and request.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {request.top_k}")
+        if request.top_p is not None and not 0.0 < request.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {request.top_p}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._requests[rid] = request
+        self._results[rid] = []
+        self._done[rid] = False
+        heapq.heappush(self._queue,
+                       (int(request.arrival_step), rid, request))
+        return rid
+
+    # -- admission -----------------------------------------------------------
+    def _admit(self, firsts: List[Tuple[int, Any]]) -> None:
+        while self._queue:
+            arrival, rid, req = self._queue[0]
+            if arrival > self._step_idx:
+                break
+            free = np.flatnonzero(~self._active)
+            if free.size == 0:
+                break
+            heapq.heappop(self._queue)
+            slot = int(free[0])
+            firsts.append((rid, self._prefill_into(slot, rid, req)))
+
+    def _prefill_into(self, slot: int, rid: int, req: Request):
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        bucket = bucket_for(prompt.size, self.min_bucket, self.max_len)
+        fn = self._prefills.get(bucket)
+        if fn is None:
+            fn = jax.jit(partial(llama.prefill_slot, self.cfg,
+                                 mesh=self.mesh), donate_argnums=(4,))
+            self._prefills[bucket] = fn
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :prompt.size] = prompt
+        tok, self._kv, self._sv = fn(
+            self.params, padded, np.int32(prompt.size),
+            np.int32(slot), self._kv, self._sv,
+            jax.random.PRNGKey(req.seed),
+            np.float32(req.temperature),
+            np.int32(self.cfg.vocab_size if req.top_k is None
+                     else req.top_k),
+            np.float32(1.0 if req.top_p is None else req.top_p))
+        self._active[slot] = True
+        self._temps[slot] = req.temperature
+        self._topks[slot] = (self.cfg.vocab_size if req.top_k is None
+                             else req.top_k)
+        self._topps[slot] = 1.0 if req.top_p is None else req.top_p
+        self._slot_rid[slot] = rid
+        return tok
+
+    # -- stepping ------------------------------------------------------------
+    def _dispatch(self, firsts) -> _Dispatch:
+        sampled, self._kv, self._sv = self._decode(
+            self.params, self._kv, self._sv, self._active,
+            self._temps, self._topks, self._topps)
+        self.steps_run += 1
+        slots = [(s, rid) for s, rid in enumerate(self._slot_rid)
+                 if self._active[s] and rid is not None]
+        return _Dispatch(sampled, slots, firsts)
+
+    def _emit(self, rid: int, token: int, now: float) -> None:
+        self._results[rid].append(token)
+        self.token_log.append((rid, token, now))
+        req = self._requests[rid]
+        if req.on_token is not None:
+            req.on_token(rid, token)
+        if len(self._results[rid]) >= req.max_new_tokens:
+            self._done[rid] = True
+
+    def _process(self, disp: _Dispatch) -> None:
+        now = time.perf_counter()
+        for rid, dev in disp.firsts:
+            self._emit(rid, int(np.asarray(dev)[0]), now)
+        if disp.slots:
+            sampled = np.asarray(disp.sampled)
+            for slot, rid in disp.slots:
+                if not self._done[rid]:
+                    self._emit(rid, int(sampled[slot]), now)
+        for slot, rid in enumerate(self._slot_rid):
+            if rid is not None and self._done[rid]:
+                self._active[slot] = False       # recycle at the next
+                self._slot_rid[slot] = None      # step boundary
+
+    # -- the serving loop ----------------------------------------------------
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drain the queue: admit → dispatch → (overlapped) process,
+        until every submitted request has completed. Returns
+        {rid: generated tokens} (prompts not included, matching the
+        ``generate`` continuation)."""
+        prev: Optional[_Dispatch] = None
+        while self._queue or self._active.any() or prev is not None:
+            firsts: List[Tuple[int, Any]] = []
+            self._admit(firsts)
+            # any admission leaves its slot active, so firsts are
+            # always carried by a dispatch
+            out = (self._dispatch(firsts) if self._active.any()
+                   else None)
+            if not self.overlap and out is not None:
+                self._process(out)
+                out = None
+            if prev is not None:
+                self._process(prev)
+            prev = out
+            self._step_idx += 1
+            if (prev is None and not self._active.any()
+                    and self._queue):
+                # idle until the next scheduled arrival
+                self._step_idx = max(self._step_idx,
+                                     self._queue[0][0])
+        return {rid: np.asarray(toks, np.int32)
+                for rid, toks in self._results.items()}
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Compiled programs this engine has built: one per prefill
+        bucket + the single decode program. The churn test gates this
+        at ``buckets + 1`` — requests entering/leaving must never
+        retrace."""
+        # deliberately NO fallback: if jax moves the private
+        # _cache_size API this raises loudly — a silent
+        # len(fns) stand-in would make the no-retrace gate
+        # vacuously true exactly when a retrace bug could hide
+        fns = [self._decode] + list(self._prefills.values())
+        return int(sum(f._cache_size() for f in fns))
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self._prefills)
+
+    def latency_stats(self) -> Dict[str, float]:
+        """Per-token latency from the emission log: p50/p99 over the
+        gaps between a request's consecutive tokens (ms)."""
+        by_rid: Dict[int, List[float]] = {}
+        for rid, _tok, t in self.token_log:
+            by_rid.setdefault(rid, []).append(t)
+        gaps = [1e3 * (b - a) for ts in by_rid.values()
+                for a, b in zip(ts, ts[1:])]
+        if not gaps:
+            return {"p50_token_ms": 0.0, "p99_token_ms": 0.0,
+                    "n_gaps": 0}
+        return {"p50_token_ms": float(np.percentile(gaps, 50)),
+                "p99_token_ms": float(np.percentile(gaps, 99)),
+                "n_gaps": len(gaps)}

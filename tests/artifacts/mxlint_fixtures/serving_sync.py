@@ -1,0 +1,67 @@
+"""Seeded mxlint fixture: MXL004 serving-latency violations — host
+syncs inside a decode/generate loop body, the classic per-token
+pipeline stall continuous batching exists to avoid. Two qualifying
+contexts: a decode/generate/serve-NAMED function, and a loop whose
+body itself dispatches a decode/generate call. Never imported; AST
+only."""
+import numpy as np
+
+import jax
+from mxtpu.models import llama
+
+
+def serve_requests(cfg, params, tok, cache, n):
+    """Name-context: strong syncs in a loop inside a *serve* function
+    are flagged; float()/int() are NOT in this context (they are
+    usually host-value parses unless the loop provably dispatches
+    decode — see token_loop)."""
+    outs = []
+    for _ in range(n):
+        lg, cache = step(params, tok, cache)
+        outs.append(np.asarray(lg))  # seeded: MXL004
+        outs.append(lg.max().item())  # seeded: MXL004
+        total = float(n)  # weak sync without decode colocation: clean
+    return outs, total
+
+
+def token_loop(cfg, params, tok, cache, n):
+    """Call-context: the loop body dispatches decode_step, so every
+    per-iteration sync is the bug even though the function name is
+    neutral."""
+    toks = []
+    while len(toks) < n:
+        lg, cache = llama.decode_step(cfg, params, tok, cache)
+        tok = lg.argmax(-1)[:, None]
+        tok.block_until_ready()  # seeded: MXL004
+        toks.append(int(tok[0, 0]))  # seeded: MXL004
+        jax.device_get(lg)  # seeded: MXL004
+    host = np.asarray(lg)  # after the loop: no finding
+    return toks, host
+
+
+def overlapped_ok(cfg, params, tok, cache, n):
+    """The fixed shape: dispatch step t+1 before reading step t back —
+    the loop still contains the decode call but no sync."""
+    prev = None
+    outs = []
+    for _ in range(n):
+        lg, cache = llama.decode_step(cfg, params, tok, cache)
+        tok = lg.argmax(-1)[:, None]
+        if prev is not None:
+            outs.append(prev)
+        prev = tok
+    outs.append(np.asarray(prev))  # outside the loop: no finding
+    return outs
+
+
+def data_loop(batches, net):
+    """A plain host data loop syncing per batch is NOT a serving
+    decode loop — no finding without the decode context."""
+    total = 0.0
+    for x in batches:
+        total += float(net(x).mean())
+    return total
+
+
+def step(params, tok, cache):
+    return tok, cache

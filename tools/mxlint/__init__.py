@@ -47,7 +47,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.mxlint",
         description="mxlint: trace-safety static analysis for mxtpu "
-                    "(rules MXL001-MXL003; see docs/lint.md)")
+                    "(rules MXL001-MXL004; see docs/lint.md)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint (default: "
                          "mxtpu/ example/ relative to the repo root)")
