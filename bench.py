@@ -34,6 +34,34 @@ BASELINE_BERT_SAMPLES_S = 250.0  # per-A100 share of the 8×A100 target
 V5E_PEAK_FLOPS = 197e12          # bf16 peak, one v5e chip
 
 
+def run_metadata():
+    """Self-describing run context stamped into every emitted record
+    (ISSUE 5 satellite): a BENCH_*.json entry must answer what jax,
+    what silicon, how many devices, and whether the measured program
+    recompiled mid-run — without cross-referencing the driver logs."""
+    from mxtpu import telemetry
+    dev = jax.devices()[0]
+    reg = telemetry.registry()
+    recompiles = sum(
+        child.value
+        for fam in reg.families() if fam.name == "recompile_total"
+        for child in fam.children.values())
+    return {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "n_devices": jax.device_count(),
+        "mesh_shape": {"dp": jax.device_count()},   # the headline
+        # benches' default mesh; multi-axis configs also carry their
+        # own "mesh" field in-record
+        "telemetry_enabled": telemetry.enabled(),
+        "telemetry": {
+            "compile_total": int(reg.value("jax_compile_total")),
+            "recompile_total": int(recompiles),
+        },
+    }
+
+
 def _time_steps(step_fn, state, batch, warmup=3, steps=20):
     for _ in range(warmup):
         state, loss = step_fn(state, batch)
@@ -234,8 +262,7 @@ def bench_llama_serve(n_requests=48, max_slots=16, max_len=768,
             prompt=rng.integers(0, cfg.vocab_size, plen),
             max_new_tokens=2, seed=j))
     engine.run()
-    engine.token_log.clear()
-    engine.steps_run = 0
+    engine.reset_stats()
     arrival = 0.0
     total_new = 0
     for _ in range(n_requests):
@@ -839,13 +866,15 @@ def main_gate(argv):
                  else flagship)
         current = gate_measure(names)
 
+    meta = run_metadata()
     if args.out:
         with open(args.out, "w") as f:
-            json.dump({"configs": current, "tolerance": tol}, f,
-                      indent=1, sort_keys=True)
+            json.dump({"configs": current, "tolerance": tol,
+                       "meta": meta}, f, indent=1, sort_keys=True)
     if args.update:
         with open(args.baseline, "w") as f:
             json.dump({"configs": current, "tolerance": tol,
+                       "meta": meta,
                        "_provenance": "bench.py gate --update; refresh "
                        "on intentional change via ci/runtime_functions"
                        ".sh bench_gate_baseline (real-chip box)"},
@@ -874,7 +903,16 @@ def main_gate(argv):
     return 0
 
 
+def _emit(rec):
+    """Print ONE self-describing JSON record (meta stamped on every
+    emission path, not just the aggregate mode)."""
+    rec["meta"] = run_metadata()
+    print(json.dumps(rec))
+
+
 def main():
+    from mxtpu import telemetry
+    telemetry.install_compile_listener()   # meta compile counts
     if len(sys.argv) > 1 and sys.argv[1] == "gate":
         raise SystemExit(main_gate(sys.argv[2:]))
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
@@ -886,25 +924,25 @@ def main():
             "aot8b_decode|aot_moe|aot8b_int8|aot8b_32k|input|serve|"
             f"gate ...] (got {only!r})")
     if only == "serve":
-        print(json.dumps(bench_llama_serve()))
+        _emit(bench_llama_serve())
         return
     if only == "smoke":
-        print(json.dumps(bench_smoke_run()))
+        _emit(bench_smoke_run())
         return
     if only == "aot8b":
-        print(json.dumps(bench_aot8b()))
+        _emit(bench_aot8b())
         return
     if only == "aot8b_decode":
-        print(json.dumps(bench_aot8b_decode()))
+        _emit(bench_aot8b_decode())
         return
     if only == "aot_moe":
-        print(json.dumps(bench_aot_moe()))
+        _emit(bench_aot_moe())
         return
     if only == "aot8b_int8":
-        print(json.dumps(bench_aot8b_int8()))
+        _emit(bench_aot8b_int8())
         return
     if only == "aot8b_32k":
-        print(json.dumps(bench_aot8b_32k()))
+        _emit(bench_aot8b_32k())
         return
     extras = []
     img_s = mfu_r = 0.0
@@ -928,7 +966,7 @@ def main():
                        "vs_baseline": round(s_s / BASELINE_BERT_SAMPLES_S,
                                             3)})
     if only == "input":
-        print(json.dumps(bench_input_pipeline()))
+        _emit(bench_input_pipeline())
         return
     if only in ("all", "llama"):
         t_s, mfu_l, n_p = bench_llama()
@@ -961,7 +999,7 @@ def main():
         # llama's train tok/s, not the decode extra) and nest the rest
         # ('extra' always present: every mode emits a uniform shape)
         out = dict(extras[0], extra=extras[1:])
-    print(json.dumps(out))
+    _emit(out)
 
 
 if __name__ == "__main__":

@@ -140,6 +140,53 @@ print(f"serve_smoke: OK ({len(reqs)} requests, "
 PYEOF
 }
 
+telemetry_smoke() {
+    # the observability layer end to end in a fresh process on the
+    # ENABLED-BY-DEFAULT path (docs/observability.md): metrics through
+    # real subsystem work, a valid Prometheus text dump, a parseable
+    # chrome-trace JSONL stream, a recompile attributed to its cache
+    # key, and a readable flight-recorder dump. The full contract is
+    # tier-1 in tests/test_telemetry.py; this proves it without pytest.
+    python - << 'PYEOF'
+import json, os, tempfile
+tmp = tempfile.mkdtemp()
+trace_path = os.path.join(tmp, "trace.jsonl")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXTPU_TELEMETRY_TRACE_PATH"] = trace_path
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from mxtpu import telemetry as tm
+
+assert tm.enabled(), "telemetry must be on by default"
+tm.install_compile_listener()
+with tm.span("smoke.outer", stage="ci"):
+    f = tm.watch(jax.jit(lambda x: x * 2), "smoke_fn", expected=1)
+    f(jnp.ones((4,), jnp.float32))
+    f(jnp.ones((4,), jnp.float32))       # cached
+    f(jnp.ones((8,), jnp.float32))       # cache-key bust -> recompile
+assert tm.registry().value("jax_compile_total") >= 2
+assert tm.registry().value("recompile_total", fn="smoke_fn") == 1
+assert "8" in f.compiles[-1], f.compiles
+
+prom = tm.prometheus()
+assert "# TYPE mxtpu_jax_compile_total counter" in prom, prom[:400]
+for line in prom.splitlines():
+    assert line.startswith("#") or " " in line, line
+
+with open(trace_path) as fh:
+    events = [json.loads(l) for l in fh]
+assert any(e["name"] == "smoke.outer" for e in events), events
+
+dump = tm.flight().dump(os.path.join(tmp, "flight.jsonl"))
+recs = [json.loads(l) for l in open(dump)]
+assert any(r["kind"] == "recompile" for r in recs), recs
+print(f"telemetry_smoke: OK ({len(events)} trace events, "
+      f"{len(recs)} flight records, prometheus "
+      f"{len(prom.splitlines())} lines)")
+PYEOF
+}
+
 opperf_gate() {
     # VERDICT r3 weak #5 + r4 #3: the 329/329 coverage claim must be
     # RECORDED, and per-op latency must be GATED against a committed
@@ -271,6 +318,7 @@ ci_all() {
     multichip_dryrun
     bench_smoke
     serve_smoke
+    telemetry_smoke
     opperf_coverage
     bench_gate
 }
@@ -285,6 +333,7 @@ ci_fast() {
     unittest_fast
     bench_smoke
     serve_smoke
+    telemetry_smoke
 }
 
 # no-argument invocation runs the fast inner loop, so the cheap,

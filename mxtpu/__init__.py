@@ -56,7 +56,7 @@ def __getattr__(name):
             "sym", "model", "engine", "parallel", "models", "ops",
             "utils", "amp", "contrib", "rnn", "serde", "module", "mod",
             "monitor", "operator", "checkpoint", "native", "rtc",
-            "visualization", "viz", "serve"}
+            "visualization", "viz", "serve", "telemetry"}
     if name in lazy:
         mod = {"sym": "mxtpu.symbol", "np": "mxtpu.numpy",
                "npx": "mxtpu.numpy_extension",

@@ -1,9 +1,18 @@
-"""Training callbacks (reference ``python/mxnet/callback.py`` [path cite])."""
+"""Training callbacks (reference ``python/mxnet/callback.py`` [path cite]).
+
+``Speedometer`` and ``log_train_metric`` double as telemetry sources:
+every firing routes through the process-wide registry
+(``train_samples_per_s`` / ``train_batch_ms`` / ``train_metric{name}``
+— docs/observability.md), and an optional ``summary_writer``
+(``mxtpu.contrib.summary.SummaryWriter``) mirrors the same scalars to
+TensorBoard. Logging behavior is unchanged.
+"""
 from __future__ import annotations
 
 import logging
 import time
-from typing import Optional
+
+from . import telemetry
 
 __all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "log_train_metric",
            "module_checkpoint"]
@@ -11,16 +20,41 @@ __all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "log_train_metric",
 
 class Speedometer:
     """Logs samples/sec every ``frequent`` batches (the reference's
-    throughput monitor)."""
+    throughput monitor). ``summary_writer`` optionally mirrors speed +
+    metrics to TensorBoard; the telemetry registry always gets them."""
 
     def __init__(self, batch_size: int, frequent: int = 50,
-                 auto_reset: bool = True):
+                 auto_reset: bool = True, summary_writer=None):
         self.batch_size = batch_size
         self.frequent = frequent
         self.init = False
         self.tic = 0.0
         self.last_count = 0
         self.auto_reset = auto_reset
+        self.summary_writer = summary_writer
+        self._m_speed = telemetry.gauge(
+            "train_samples_per_s", "Training throughput (Speedometer)")
+        self._m_batches = telemetry.counter(
+            "train_batches_total", "Batches processed (Speedometer)")
+        self._m_batch_ms = telemetry.histogram(
+            "train_batch_ms",
+            "Wall time per batch over each Speedometer window — with "
+            "train_data_wait_ms and span_train_dispatch_ms this splits "
+            "the step: device ≈ wall − data_wait − dispatch")
+
+    def _export(self, speed: float, per_batch_ms: float, name_value,
+                step: int) -> None:
+        self._m_speed.set(speed)
+        self._m_batches.inc(self.frequent)
+        self._m_batch_ms.observe(per_batch_ms)
+        for name, value in name_value:
+            telemetry.gauge("train_metric", "Latest training metric "
+                            "value", metric=name).set(value)
+        sw = self.summary_writer
+        if sw is not None:
+            sw.add_scalar("train/samples_per_s", speed, step)
+            for name, value in name_value:
+                sw.add_scalar(f"train/{name}", value, step)
 
     def __call__(self, param) -> None:
         count = param.nbatch
@@ -29,10 +63,13 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
+                elapsed = time.time() - self.tic
+                speed = self.frequent * self.batch_size / elapsed
+                name_value = [] if param.eval_metric is None else \
+                    param.eval_metric.get_name_value()
+                self._export(speed, 1e3 * elapsed / self.frequent,
+                             name_value, count)
                 if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s"
@@ -77,11 +114,17 @@ def do_checkpoint(prefix: str, period: int = 1):
 module_checkpoint = do_checkpoint
 
 
-def log_train_metric(period: int, auto_reset: bool = False):
+def log_train_metric(period: int, auto_reset: bool = False,
+                     summary_writer=None):
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:
             name_value = param.eval_metric.get_name_value()
             for name, value in name_value:
+                telemetry.gauge("train_metric", "Latest training "
+                                "metric value", metric=name).set(value)
+                if summary_writer is not None:
+                    summary_writer.add_scalar(f"train/{name}", value,
+                                              param.nbatch)
                 logging.info("Iter[%d] Batch[%d] Train-%s=%f",
                              param.epoch, param.nbatch, name, value)
             if auto_reset:

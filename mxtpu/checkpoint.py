@@ -144,10 +144,23 @@ class PreemptionGuard:
         self._old: dict = {}
         self.preempted = False
         self.signum: Optional[int] = None
+        self.flight_dump_path: Optional[str] = None
 
     def _handler(self, signum, frame):
         self.preempted = True
         self.signum = signum
+        # crash-path observability: persist the flight recorder NOW —
+        # if the scheduler escalates to SIGKILL before the final save
+        # finishes, the dump is the only record of the job's last
+        # moments. Never let telemetry failure break the save path.
+        try:
+            from . import telemetry
+            if telemetry.enabled():      # honor the kill switch: a
+                telemetry.flight().record("preemption", "signal",
+                                          signum=int(signum))
+                self.flight_dump_path = telemetry.flight().dump()
+        except Exception:                # disabled run writes nothing
+            pass
 
     def __enter__(self) -> "PreemptionGuard":
         for s in self._signals:

@@ -30,9 +30,12 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time as _time
 from typing import Any, Iterable, Optional
 
 import numpy as _np
+
+from ... import telemetry
 
 __all__ = ["DevicePrefetcher"]
 
@@ -71,6 +74,12 @@ class DevicePrefetcher:
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        # the data-wait leg of the step-time split: time the CONSUMER
+        # spends blocked on the producer (0 when prefetch is winning)
+        self._m_wait = telemetry.histogram(
+            "train_data_wait_ms",
+            "Time the training loop blocked waiting for the next "
+            "prefetched batch")
 
     # -- device placement -------------------------------------------------
     def _to_device(self, obj):
@@ -160,8 +169,11 @@ class DevicePrefetcher:
 
     def __next__(self):
         self._ensure_started()
+        t0 = _time.perf_counter()
         try:
             item = self._q.get(timeout=self._timeout)
+            if item is not _SENTINEL:      # epoch-end is not data wait
+                self._m_wait.observe(1e3 * (_time.perf_counter() - t0))
         except _queue.Empty:
             raise RuntimeError(
                 f"DevicePrefetcher: no batch from source within "

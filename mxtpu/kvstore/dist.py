@@ -11,6 +11,7 @@ barrier — see mxtpu.kvstore.server.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict
 
 import jax
@@ -24,6 +25,8 @@ __all__ = ["DistKVStore", "AsyncDistKVStore"]
 
 
 class DistKVStore(KVStore):
+    _store_seq = itertools.count(1)     # per-store gauge label ids
+
     def __init__(self, kv_type: str):
         super().__init__(kv_type)
         from ..parallel import dist
@@ -111,6 +114,25 @@ class DistKVStore(KVStore):
                 lambda ts: [t.sum(axis=0) for t in ts],
                 out_shardings=NamedSharding(mesh, P()))
             cache[key] = fn
+            from .. import telemetry
+            # steady state is 1 program; growth = signature churn (a
+            # param added mid-run, dtype drift) — same anomaly family
+            # as recompile_total. Labelled per store: a second store's
+            # first compile must not mask the first store's anomaly.
+            mg = getattr(self, "_m_progs", None)
+            if mg is None:
+                mg = self._m_progs = telemetry.gauge(
+                    "kv_collective_programs",
+                    "Distinct compiled allreduce programs on the "
+                    "kvstore fast path (steady-state training sits "
+                    "at 1)", store=str(next(DistKVStore._store_seq)))
+            mg.set(len(cache))
+        m = getattr(self, "_m_allreduce", None)
+        if m is None:        # handle created once (hot path)
+            from .. import telemetry
+            m = self._m_allreduce = telemetry.counter(
+                "kv_allreduce_total", "Fast-path fused allreduce calls")
+        m.inc()
         reduced = fn(global_arrays)
         # replicated output: this process's addressable shard is the sum
         return [jnp.asarray(r.addressable_data(0)) for r in reduced]

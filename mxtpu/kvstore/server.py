@@ -81,6 +81,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as onp
 
+from .. import telemetry
 from ..base import (MXNetError, atomic_write, env_float, env_int, env_str)
 
 __all__ = ["KVStoreServer", "ServerClient", "server_address",
@@ -230,8 +231,13 @@ def _send_msg(sock: socket.socket, obj: Any,
     sock.sendall(_LEN.pack(len(out) + len(mac)) + mac + out)
 
 
-def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None):
-    """Returns (message, authenticated: bool)."""
+def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None,
+              observe=None):
+    """Returns (message, authenticated: bool). ``observe``, when set,
+    is called with the frame's byte length (the server feeds its
+    request-size histogram through it; decode errors still count —
+    an oversized foreign frame is exactly what the histogram should
+    show)."""
     hdr = b""
     while len(hdr) < _LEN.size:
         chunk = sock.recv(_LEN.size - len(hdr))
@@ -239,6 +245,8 @@ def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None):
             raise ConnectionError("kvstore server connection closed")
         hdr += chunk
     (n,) = _LEN.unpack(hdr)
+    if observe is not None:
+        observe(n)
     if n > _MAX_FRAME:
         raise PSProtocolError(
             f"implausible frame length {n} — peer is not an mxtpu "
@@ -321,6 +329,19 @@ class KVStoreServer:
                            "(<=0 disables the time trigger).")
         self._mutations_since_snap = 0
         self._last_snap_time = time.monotonic()
+        # retries/dedups/snapshots were invisible before this layer —
+        # the PR 2 chaos debugging story, made permanent
+        self._m_dedup = telemetry.counter(
+            "ps_dedup_hits_total",
+            "Replayed (client_id, seq) requests answered from the "
+            "dedup cache without re-applying")
+        self._m_snap = telemetry.histogram(
+            "ps_snapshot_seconds", "Crash-recovery snapshot write time",
+            buckets=telemetry.SECONDS_BUCKETS)
+        self._m_frame = telemetry.histogram(
+            "ps_request_bytes", "Inbound request frame sizes",
+            buckets=telemetry.BYTES_BUCKETS)
+        self._m_ops: Dict[str, Any] = {}     # per-op request counters
         if self._snap_path:
             self._load_snapshot()
         # captured once: a later env mutation must not silently change
@@ -376,11 +397,14 @@ class KVStoreServer:
         retried in-flight request exactly-once across the restart."""
         if not self._snap_path:
             return
+        t0 = time.perf_counter()
         blob = pickle.dumps({"store": self._store,
                              "updaters": self._updaters,
                              "sessions": self._sessions},
                             protocol=pickle.HIGHEST_PROTOCOL)
         atomic_write(self._snap_path, blob)
+        self._m_snap.observe(time.perf_counter() - t0)
+        telemetry.flight().record("ps", "snapshot", bytes=len(blob))
         self._mutations_since_snap = 0
         self._last_snap_time = time.monotonic()
 
@@ -436,7 +460,8 @@ class KVStoreServer:
         with conn:
             while True:
                 try:
-                    msg, authed = _recv_msg(conn, self._secret)
+                    msg, authed = _recv_msg(conn, self._secret,
+                                            observe=self._m_frame.observe)
                 except (PSAuthError, PSProtocolError) as e:
                     # the peer is ALIVE but unauthenticated/foreign:
                     # best-effort plaintext error so it fails fast
@@ -467,9 +492,17 @@ class KVStoreServer:
             _, cid, seq, inner = msg
             if not (isinstance(inner, tuple) and inner):
                 return ("err", "malformed request envelope")
+            op = str(inner[0])
+            m_op = self._m_ops.get(op)
+            if m_op is None:      # handle per op, created once
+                m_op = self._m_ops[op] = telemetry.counter(
+                    "ps_requests_total", "Requests served, by op",
+                    op=op)
+            m_op.inc()
             with self._lock:
                 last = self._sessions.get(cid)
                 if last is not None and last[0] == seq:
+                    self._m_dedup.inc()
                     # duplicate delivery. Mutations replay the CACHED
                     # ack; reads are idempotent and re-execute (their
                     # replies — full parameter pulls — are never
@@ -693,6 +726,15 @@ class ServerClient:
         # test-only fault injection hook (mxtpu.contrib.chaos): called
         # around each send so drops/dups/delays are deterministic
         self.chaos = None
+        self._m_retries = telemetry.counter(
+            "ps_retries_total",
+            "Client request attempts retried after a connection fault")
+        self._m_reconnects = telemetry.counter(
+            "ps_reconnects_total",
+            "Client reconnections to the parameter server")
+        self._m_auth_fail = telemetry.counter(
+            "ps_auth_failures_total",
+            "Frames that failed HMAC verification (secret mismatch)")
         self._connect(time.monotonic() + timeout, verify=False)
 
     # -- connection management -------------------------------------------
@@ -780,6 +822,10 @@ class ServerClient:
                 if self._sock is None:
                     # reconnect path: heartbeat-verified (see _connect)
                     self._connect(deadline, verify=True)
+                    self._m_reconnects.inc()
+                    telemetry.flight().record(
+                        "ps", "reconnect", addr=str(self._addr),
+                        attempt=attempt)
                 chaos = self.chaos
                 if chaos is not None:
                     chaos.on_request(self)
@@ -789,6 +835,7 @@ class ServerClient:
                 reply, _ = _recv_msg(self._sock, self._secret)
                 return reply
             except PSAuthError as e:
+                self._m_auth_fail.inc()
                 self._drop_socket()
                 raise MXNetError(
                     f"kvstore server at {self._addr}: {e} — "
@@ -801,6 +848,7 @@ class ServerClient:
             except (ConnectionError, OSError) as e:
                 self._drop_socket()
                 attempt += 1
+                self._m_retries.inc()
                 now = time.monotonic()
                 if now >= deadline:
                     raise MXNetError(

@@ -183,8 +183,18 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
     jitted = jax.jit(_step, in_shardings=(None, bsharding, None),
                      donate_argnums=(0,))
 
+    from .. import telemetry
+    telemetry.install_compile_listener()
+    dispatch_span = telemetry.span_factory("train.step_dispatch",
+                                           "train_dispatch")
+
     def step(state: TrainState, batch, rng=None):
-        return jitted(state, batch, rng)
+        # host DISPATCH time only (the program runs async) — with the
+        # prefetcher's data-wait histogram and the loop's wall clock
+        # this is the step-time split docs/observability.md reads:
+        # device ≈ wall − data_wait − dispatch
+        with dispatch_span():
+            return jitted(state, batch, rng)
 
     step._jitted = jitted
     return step

@@ -13,6 +13,7 @@ Two layers, mirroring the reference's engine-hook + chrome-trace design:
 """
 from __future__ import annotations
 
+import json
 import os
 import time
 from collections import defaultdict
@@ -24,7 +25,7 @@ __all__ = ["set_config", "start", "stop", "pause", "resume", "dump",
 _config = {"filename": "profile.json", "profile_all": False,
            "profile_symbolic": True, "profile_imperative": True,
            "aggregate_stats": False}
-_state = {"running": False, "trace_dir": None}
+_state = {"running": False, "paused": False, "trace_dir": None}
 _agg: Dict[str, list] = defaultdict(lambda: [0, 0.0])   # name → [count, time]
 
 
@@ -77,11 +78,38 @@ def _start(clear_agg: bool):
 
 def start():
     """Start profiling (reference ``mx.profiler.start``)."""
+    if _state["running"]:
+        # already profiling: a start() during pause must reinstall the
+        # aggregation hook (otherwise the paused flag clears while ops
+        # go uncounted and only resume() could recover)
+        if _state["paused"]:
+            _install_hook()
+            _state["paused"] = False
+        return
     _start(clear_agg=True)
+    _state["paused"] = False
+
+
+def pause(profile_process: str = "worker"):
+    """Suspend AGGREGATION only (reference ``mx.profiler.pause``:
+    exclude a code region from the profile). The XLA trace session
+    stays alive — tearing it down (the old ``pause = stop`` aliasing)
+    silently ended the trace, and a later ``resume`` could not rejoin
+    it; ``stop``/``dump`` remain the only teardown paths."""
+    if _state["running"] and not _state["paused"]:
+        _uninstall_hook()
+        _state["paused"] = True
 
 
 def resume(profile_process: str = "worker"):
-    """Continue after pause() — aggregate stats keep accumulating."""
+    """Continue after pause() — aggregate stats keep accumulating.
+    After a full stop() this restarts the trace without clearing the
+    aggregate (the reference's run-resume semantics)."""
+    if _state["running"]:
+        if _state["paused"]:
+            _install_hook()
+            _state["paused"] = False
+        return
     _start(clear_agg=False)
 
 
@@ -96,9 +124,7 @@ def stop():
             pass
     _uninstall_hook()
     _state["running"] = False
-
-
-pause = stop
+    _state["paused"] = False
 
 
 def dump(finished: bool = True, profile_process: str = "worker"):
@@ -108,14 +134,25 @@ def dump(finished: bool = True, profile_process: str = "worker"):
 
 
 def dumps(reset: bool = False, format: str = "table") -> str:
-    """Aggregate per-op dispatch stats (reference aggregate_stats table)."""
+    """Aggregate per-op dispatch stats (reference aggregate_stats
+    table). ``format="json"`` returns the same data as a JSON object
+    ``{name: {"count": n, "time_ms": t}}`` for machine consumers."""
     rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
-    lines = [f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"]
-    for name, (count, t) in rows:
-        lines.append(f"{name:<40}{count:>12}{t * 1e3:>14.3f}")
+    if format == "json":
+        out = json.dumps({name: {"count": count,
+                                 "time_ms": round(t * 1e3, 6)}
+                          for name, (count, t) in rows})
+    elif format == "table":
+        lines = [f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"]
+        for name, (count, t) in rows:
+            lines.append(f"{name:<40}{count:>12}{t * 1e3:>14.3f}")
+        out = "\n".join(lines)
+    else:
+        raise ValueError(
+            f"unknown dumps format {format!r} (want 'table' or 'json')")
     if reset:
         _agg.clear()
-    return "\n".join(lines)
+    return out
 
 
 class Marker:

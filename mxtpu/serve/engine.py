@@ -38,6 +38,7 @@ there.
 from __future__ import annotations
 
 import heapq
+import itertools
 import os
 import time
 from dataclasses import dataclass
@@ -47,9 +48,47 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from .. import telemetry
 from ..models import llama
 
 __all__ = ["Request", "ServeEngine", "bucket_for"]
+
+# admission wait is measured in engine steps (arrival → slot grant)
+_WAIT_STEP_BUCKETS = (0.0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 1024)
+
+
+_engine_seq = itertools.count(1)     # atomic: engines build on threads
+
+
+def _engine_metrics():
+    """Process-wide serve metrics (one handle set per engine; the
+    registry interns children, so every engine shares the TOTALS).
+    Point-in-time gauges are labelled per engine instead — two live
+    engines sharing one queue-depth gauge would just overwrite each
+    other. Created at engine construction — the telemetry knob is
+    read then."""
+    eid = str(next(_engine_seq))
+    return {
+        "requests": telemetry.counter(
+            "serve_requests_total", "Requests submitted to ServeEngine"),
+        "tokens": telemetry.counter(
+            "serve_tokens_total", "Tokens emitted by ServeEngine"),
+        "steps": telemetry.counter(
+            "serve_steps_total", "Decode steps dispatched"),
+        "queue": telemetry.gauge(
+            "serve_queue_depth", "Requests queued, not yet admitted",
+            engine=eid),
+        "slots": telemetry.gauge(
+            "serve_slot_occupancy", "Active slots in the decode bank",
+            engine=eid),
+        "wait": telemetry.histogram(
+            "serve_admission_wait_steps",
+            "Engine steps between a request's arrival and its slot",
+            buckets=_WAIT_STEP_BUCKETS),
+        "latency": telemetry.histogram(
+            "serve_token_latency_ms",
+            "Inter-token gaps per request (host emission clock)"),
+    }
 
 
 def _env_int(name: str, default: int) -> int:
@@ -132,11 +171,26 @@ class ServeEngine:
         self._sv = {n: state[n] for n in ("lengths", "tokens", "rngs")}
         # the kv bank is donated through every program (in-place in
         # HBM); the small vectors are not, so the previous step's
-        # sampled tokens stay readable during the overlapped sync
-        self._decode = jax.jit(
-            partial(llama.decode_slots, cfg, mesh=mesh),
-            donate_argnums=(1,))
+        # sampled tokens stay readable during the overlapped sync.
+        # watch(): ONE decode program ever — cache growth past 1 is the
+        # spurious-recompile anomaly (recompile_total + offending key)
+        telemetry.install_compile_listener()
+        self._decode = telemetry.watch(
+            jax.jit(partial(llama.decode_slots, cfg, mesh=mesh),
+                    donate_argnums=(1,)),
+            "serve_decode", expected=1)
         self._prefills: Dict[int, Any] = {}
+        self._m = _engine_metrics()
+        # span factories pre-bind their registry histograms — the
+        # per-step/per-admission hot paths must not re-intern handles
+        self._span_decode = telemetry.span_factory(
+            "serve.decode_step", "serve_decode_dispatch")
+        self._span_prefill = telemetry.span_factory(
+            "serve.prefill", "serve_prefill")
+        # private resettable latency stats (always-on Histogram
+        # instance, independent of the global telemetry knob)
+        self._lat = telemetry.Histogram(telemetry.LATENCY_MS_BUCKETS)
+        self._last_tok: Dict[int, float] = {}
 
         S = self.max_slots
         self._active = np.zeros(S, bool)
@@ -152,7 +206,6 @@ class ServeEngine:
         self._next_rid = 0
         self._step_idx = 0
         self.steps_run = 0
-        self.token_log: List[Tuple[int, int, float]] = []
 
     # -- submission ----------------------------------------------------------
     def submit(self, request: Request) -> int:
@@ -182,6 +235,8 @@ class ServeEngine:
         self._done[rid] = False
         heapq.heappush(self._queue,
                        (int(request.arrival_step), rid, request))
+        self._m["requests"].inc()
+        self._m["queue"].set(len(self._queue))
         return rid
 
     # -- admission -----------------------------------------------------------
@@ -195,26 +250,32 @@ class ServeEngine:
                 break
             heapq.heappop(self._queue)
             slot = int(free[0])
+            self._m["wait"].observe(max(0, self._step_idx - arrival))
             firsts.append((rid, self._prefill_into(slot, rid, req)))
+        self._m["queue"].set(len(self._queue))
+        self._m["slots"].set(int(self._active.sum()))
 
     def _prefill_into(self, slot: int, rid: int, req: Request):
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         bucket = bucket_for(prompt.size, self.min_bucket, self.max_len)
         fn = self._prefills.get(bucket)
         if fn is None:
-            fn = jax.jit(partial(llama.prefill_slot, self.cfg,
-                                 mesh=self.mesh), donate_argnums=(4,))
+            fn = telemetry.watch(
+                jax.jit(partial(llama.prefill_slot, self.cfg,
+                                mesh=self.mesh), donate_argnums=(4,)),
+                f"serve_prefill_b{bucket}", expected=1)
             self._prefills[bucket] = fn
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :prompt.size] = prompt
-        tok, self._kv, self._sv = fn(
-            self.params, padded, np.int32(prompt.size),
-            np.int32(slot), self._kv, self._sv,
-            jax.random.PRNGKey(req.seed),
-            np.float32(req.temperature),
-            np.int32(self.cfg.vocab_size if req.top_k is None
-                     else req.top_k),
-            np.float32(1.0 if req.top_p is None else req.top_p))
+        with self._span_prefill(bucket=bucket):
+            tok, self._kv, self._sv = fn(
+                self.params, padded, np.int32(prompt.size),
+                np.int32(slot), self._kv, self._sv,
+                jax.random.PRNGKey(req.seed),
+                np.float32(req.temperature),
+                np.int32(self.cfg.vocab_size if req.top_k is None
+                         else req.top_k),
+                np.float32(1.0 if req.top_p is None else req.top_p))
         self._active[slot] = True
         self._temps[slot] = req.temperature
         self._topks[slot] = (self.cfg.vocab_size if req.top_k is None
@@ -225,17 +286,27 @@ class ServeEngine:
 
     # -- stepping ------------------------------------------------------------
     def _dispatch(self, firsts) -> _Dispatch:
-        sampled, self._kv, self._sv = self._decode(
-            self.params, self._kv, self._sv, self._active,
-            self._temps, self._topks, self._topps)
+        # host DISPATCH time only — the program runs async; device time
+        # belongs to the XLA trace (no sync in the decode loop, MXL004)
+        with self._span_decode():
+            sampled, self._kv, self._sv = self._decode(
+                self.params, self._kv, self._sv, self._active,
+                self._temps, self._topks, self._topps)
         self.steps_run += 1
+        self._m["steps"].inc()
         slots = [(s, rid) for s, rid in enumerate(self._slot_rid)
                  if self._active[s] and rid is not None]
         return _Dispatch(sampled, slots, firsts)
 
     def _emit(self, rid: int, token: int, now: float) -> None:
         self._results[rid].append(token)
-        self.token_log.append((rid, token, now))
+        self._m["tokens"].inc()
+        last = self._last_tok.get(rid)
+        if last is not None:
+            gap_ms = 1e3 * (now - last)
+            self._lat.observe(gap_ms)
+            self._m["latency"].observe(gap_ms)
+        self._last_tok[rid] = now
         req = self._requests[rid]
         if req.on_token is not None:
             req.on_token(rid, token)
@@ -255,6 +326,8 @@ class ServeEngine:
             if rid is not None and self._done[rid]:
                 self._active[slot] = False       # recycle at the next
                 self._slot_rid[slot] = None      # step boundary
+                self._last_tok.pop(rid, None)    # bounded: live rids only
+        self._m["slots"].set(int(self._active.sum()))
 
     # -- the serving loop ----------------------------------------------------
     def run(self) -> Dict[int, np.ndarray]:
@@ -304,16 +377,22 @@ class ServeEngine:
         return len(self._prefills)
 
     def latency_stats(self) -> Dict[str, float]:
-        """Per-token latency from the emission log: p50/p99 over the
-        gaps between a request's consecutive tokens (ms)."""
-        by_rid: Dict[int, List[float]] = {}
-        for rid, _tok, t in self.token_log:
-            by_rid.setdefault(rid, []).append(t)
-        gaps = [1e3 * (b - a) for ts in by_rid.values()
-                for a, b in zip(ts, ts[1:])]
-        if not gaps:
+        """Per-token latency: p50/p99 over the gaps between a
+        request's consecutive tokens (ms), from this engine's private
+        fixed-bucket histogram (bounded memory — the unbounded
+        per-token log it replaces grew with every request; the same
+        gaps also feed the process-wide ``serve_token_latency_ms``)."""
+        n = self._lat.count
+        if n == 0:
             return {"p50_token_ms": 0.0, "p99_token_ms": 0.0,
                     "n_gaps": 0}
-        return {"p50_token_ms": float(np.percentile(gaps, 50)),
-                "p99_token_ms": float(np.percentile(gaps, 99)),
-                "n_gaps": len(gaps)}
+        return {"p50_token_ms": float(self._lat.percentile(50)),
+                "p99_token_ms": float(self._lat.percentile(99)),
+                "n_gaps": n}
+
+    def reset_stats(self) -> None:
+        """Zero the per-engine latency histogram + step counter (the
+        bench warmup boundary)."""
+        self._lat.reset()
+        self._last_tok.clear()
+        self.steps_run = 0

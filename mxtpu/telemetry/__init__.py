@@ -1,0 +1,181 @@
+"""mxtpu.telemetry — unified runtime observability (docs/observability.md).
+
+One process-wide, thread-safe layer with four pieces:
+
+- **metrics registry** (labelled ``Counter``/``Gauge``/``Histogram``
+  with fixed-bucket percentiles) — ``telemetry.counter("name").inc()``,
+  exported as a Prometheus text dump (:func:`prometheus`) or a human
+  table (:func:`summary`);
+- **span tracing** — ``with telemetry.span("prefill", bucket=256):``
+  emits chrome://tracing-compatible events alongside the XLA trace
+  ``mx.profiler`` owns (host dispatch time here, device time there);
+- **flight recorder** — a bounded ring of recent events that
+  ``PreemptionGuard``/crash paths dump to disk (:func:`flight`);
+- **recompile watcher** — every backend compilation is counted
+  process-wide, and :func:`watch`-wrapped programs attribute each
+  compile to its cache key, so an anomalous ``recompile_total`` points
+  at the offending signature instead of a bisection session.
+
+Enabled by default; ``MXTPU_TELEMETRY=0`` turns every recording call
+into a no-op (handles created while disabled never record — the knob
+is read when a handle is created, keeping the hot path branch-free).
+The instrument classes themselves (``telemetry.Histogram()`` etc.)
+always work when constructed directly — subsystems use them for
+private resettable stats regardless of the global knob.
+
+Instrumented out of the box: ``mxtpu.serve.ServeEngine`` (queue/slots/
+admission/latency/spans), the sharded train step + ``DevicePrefetcher``
++ ``Speedometer`` (step-time split), and the ``kvstore`` client/server
+(retries, dedups, reconnects, snapshot timing, frame sizes).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..base import env_bool
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       BYTES_BUCKETS, LATENCY_MS_BUCKETS,
+                       SECONDS_BUCKETS)
+from .flight import FlightRecorder, default_flight_path
+from . import tracing as _tracing
+from .tracing import (Span, clear_trace, current_depth, dump_trace,
+                      trace_events)
+from .watcher import WatchedFunction, describe_args, watch
+from .watcher import install as install_compile_listener
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "FlightRecorder", "Span", "WatchedFunction",
+    "counter", "gauge", "histogram", "span", "span_factory", "instant",
+    "registry", "flight", "enabled", "enable", "reset",
+    "prometheus", "summary", "dump_trace", "trace_events",
+    "clear_trace", "current_depth", "describe_args", "watch",
+    "install_compile_listener", "default_flight_path",
+    "LATENCY_MS_BUCKETS", "BYTES_BUCKETS", "SECONDS_BUCKETS",
+]
+
+class _GuardedFlight(FlightRecorder):
+    """The process singleton: honors the MXTPU_TELEMETRY kill switch
+    dynamically (unlike metric handles, flight callers hold the
+    singleton long-term, so the check belongs at record time). A
+    directly-constructed FlightRecorder is never gated."""
+
+    def record(self, kind, name, **fields):
+        if _enabled:
+            super().record(kind, name, **fields)
+
+
+_REGISTRY = MetricsRegistry()
+_FLIGHT = _GuardedFlight()
+_enabled = env_bool(
+    "MXTPU_TELEMETRY", True,
+    "Master switch for the runtime telemetry layer (metrics, spans, "
+    "flight recorder). 0 disables all recording.")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Runtime override of MXTPU_TELEMETRY (tests; emergency off
+    switch). Affects handles created AFTER the call."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (always real — exporters read it even
+    when recording is disabled)."""
+    return _REGISTRY
+
+
+def flight() -> FlightRecorder:
+    return _FLIGHT
+
+
+# -- no-op handles (returned while disabled) -------------------------------
+class _Noop:
+    def inc(self, amount: float = 1.0) -> None: pass
+    def dec(self, amount: float = 1.0) -> None: pass
+    def set(self, value: float) -> None: pass
+    def observe(self, value: float) -> None: pass
+    value = 0.0
+    count = 0
+
+
+_NOOP = _Noop()
+
+
+class _NoopRegistry:
+    def counter(self, *a, **k): return _NOOP
+    def gauge(self, *a, **k): return _NOOP
+    def histogram(self, *a, **k): return _NOOP
+
+
+_NOOP_REGISTRY = _NoopRegistry()
+
+
+def _metrics():
+    """Registry for WRITERS: the real one when enabled, no-ops when
+    not (instrumentation sites call this once at handle creation)."""
+    return _REGISTRY if _enabled else _NOOP_REGISTRY
+
+
+def counter(name: str, help: str = "", **labels):
+    return _metrics().counter(name, help, **labels)
+
+
+def gauge(name: str, help: str = "", **labels):
+    return _metrics().gauge(name, help, **labels)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Optional[Sequence[float]] = None, **labels):
+    return _metrics().histogram(name, help, buckets=buckets, **labels)
+
+
+def span(name: str, histogram_name: Optional[str] = None, **args):
+    """A traced span. When telemetry is disabled this still returns a
+    working ``Span`` timer but records nothing. ``histogram_name``
+    additionally feeds the duration (ms) into that registry histogram;
+    every span lands in the flight recorder."""
+    return span_factory(name, histogram_name)(**args)
+
+
+def span_factory(name: str, histogram_name: Optional[str] = None):
+    """Pre-bind a span's registry histogram once and return a callable
+    producing spans — the hot-path form (per decode step / train step,
+    ``span()``'s per-call interning would take the registry lock every
+    iteration)."""
+    if not _enabled:
+        return lambda **args: Span(name, record=False, **args)
+    h = histogram(f"span_{histogram_name or name}_ms".replace(".", "_"),
+                  f"Span durations: {name}") \
+        if histogram_name is not False else None
+
+    def make(**args):
+        return Span(name, histogram=h, flight=_FLIGHT, **args)
+    return make
+
+
+def instant(name: str, **args) -> None:
+    """An instant trace event (no-op while disabled)."""
+    if _enabled:
+        _tracing.instant(name, **args)
+
+
+def prometheus() -> str:
+    return _REGISTRY.prometheus()
+
+
+def summary() -> str:
+    return _REGISTRY.summary()
+
+
+def reset() -> None:
+    """Zero metrics, clear trace events and the flight ring (test
+    isolation). Handles held by instrumentation stay valid."""
+    _REGISTRY.reset()
+    clear_trace()
+    _FLIGHT.clear()

@@ -1,0 +1,113 @@
+"""Crash flight recorder: a bounded ring buffer of recent runtime
+events (spans, compiles, faults, explicit notes) that crash paths dump
+to disk — the "what were the last N things this job did" answer that
+a post-mortem needs when the metrics endpoint died with the process.
+
+``checkpoint.PreemptionGuard`` dumps it on SIGTERM/SIGINT;
+``tools/diagnose.py`` prints the live tail; anything can call
+``telemetry.flight().dump()`` explicitly. The buffer is size-bounded
+(``MXTPU_TELEMETRY_FLIGHT_SIZE``) so an always-on recorder costs a
+fixed few hundred KB, never an OOM.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from ..base import atomic_write, env_int, env_str
+
+__all__ = ["FlightRecorder", "default_flight_path"]
+
+
+def default_flight_path() -> str:
+    """Where a crash dump lands: ``MXTPU_TELEMETRY_FLIGHT_PATH`` or a
+    per-pid file under the system temp dir (predictable enough to find
+    after a preemption, collision-free across ranks on one host)."""
+    return env_str(
+        "MXTPU_TELEMETRY_FLIGHT_PATH", "",
+        "Flight-recorder crash-dump file; default "
+        "<tmpdir>/mxtpu_flight_<pid>.jsonl.") or os.path.join(
+            tempfile.gettempdir(), f"mxtpu_flight_{os.getpid()}.jsonl")
+
+
+class FlightRecorder:
+    def __init__(self, maxlen: Optional[int] = None):
+        if maxlen is None:
+            maxlen = env_int(
+                "MXTPU_TELEMETRY_FLIGHT_SIZE", 512,
+                "Flight-recorder ring size (recent events kept for "
+                "crash dumps).")
+        # RLock, deliberately: PreemptionGuard records+dumps from a
+        # SIGNAL HANDLER, which CPython runs on the main thread between
+        # bytecodes — if the interrupted frame already holds this lock
+        # (every span exit records), a non-reentrant lock would
+        # deadlock the process on the exact path built to save it. A
+        # non-main-thread holder only delays the handler (that thread
+        # keeps running); the deque ops under the lock are single C
+        # calls, so a re-entrant handler never sees torn state.
+        self._lock = threading.RLock()
+        self._events: Deque[Dict[str, Any]] = deque(
+            maxlen=max(1, maxlen))
+
+    def record(self, kind: str, name: str, **fields: Any) -> None:
+        evt = {"t": round(time.time(), 6), "kind": kind, "name": name}
+        evt.update(fields)
+        with self._lock:
+            self._events.append(evt)
+
+    def tail(self, n: int = 20) -> List[Dict[str, Any]]:
+        with self._lock:
+            events = list(self._events)
+        return events[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def dump(self, path: Optional[str] = None) -> str:
+        """Write the ring as JSONL (atomic tmp+rename — a dump torn by
+        the very crash it documents would be worse than none). Safe to
+        call from a signal handler: any failure is swallowed after a
+        best-effort stderr note, because the dump must never turn a
+        clean preemption save into a crash."""
+        path = path or default_flight_path()
+        with self._lock:
+            events = list(self._events)
+        try:
+            # default=repr: a numpy scalar in an event field must not
+            # cost the crash dump its moment
+            blob = "".join(json.dumps(e, default=repr) + "\n"
+                           for e in events)
+            atomic_write(path, blob.encode())
+        except Exception as e:
+            try:
+                import sys
+                sys.stderr.write(
+                    f"mxtpu telemetry: flight dump to {path!r} failed: "
+                    f"{e!r}\n")
+            except Exception:
+                pass
+        return path
+
+    def format_tail(self, n: int = 20) -> str:
+        """Human-readable tail for diagnose.py."""
+        events = self.tail(n)
+        if not events:
+            return "(flight recorder empty)"
+        lines = []
+        for e in events:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("t", "kind", "name")}
+            ts = time.strftime("%H:%M:%S", time.localtime(e["t"]))
+            lines.append(f"{ts}  {e['kind']:<9} {e['name']}"
+                         + (f"  {extra}" if extra else ""))
+        return "\n".join(lines)

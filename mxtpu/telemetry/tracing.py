@@ -1,0 +1,170 @@
+"""Lightweight span tracing: chrome://tracing-compatible events from
+host-side code, alongside (never replacing) the ``jax.profiler`` XLA
+trace.
+
+A span measures HOST wall time between ``__enter__`` and ``__exit__``
+— for dispatch-style code (the serve decode loop, the jitted train
+step) that is host dispatch time, which is exactly the quantity the
+overlapped-sync design cares about. Device time stays the XLA trace's
+job; the two are complementary, not redundant.
+
+Events accumulate in a bounded in-memory buffer (``trace_events()``,
+dumped by :func:`dump_trace` as a Trace Event Format JSON array) and,
+when ``MXTPU_TELEMETRY_TRACE_PATH`` is set, stream to that file as
+JSONL — one ``{"name": ..., "ph": "X", ...}`` object per line, which
+chrome://tracing and Perfetto both accept (their JSON importer
+tolerates a missing enclosing array).
+
+Nesting is tracked per thread: a span opened inside another span
+carries ``args.depth`` and chrome's flame view nests them by
+timestamp containment (same tid).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from ..base import env_int, env_str
+
+__all__ = ["span", "instant", "trace_events", "dump_trace",
+           "clear_trace", "Span"]
+
+_MAX_EVENTS = env_int(
+    "MXTPU_TELEMETRY_TRACE_EVENTS", 100_000,
+    "In-memory trace-event ring size; oldest events drop first.")
+
+_lock = threading.Lock()
+_events: Deque[Dict[str, Any]] = deque(maxlen=max(1, _MAX_EVENTS))
+_tls = threading.local()
+_stream_file = None
+_stream_failed = False
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+# register the knob once; the per-event check below is a bare dict
+# lookup (this runs on every recorded event, under the trace lock)
+env_str("MXTPU_TELEMETRY_TRACE_PATH", "",
+        "Stream span trace events to this file as JSONL "
+        "(chrome://tracing-compatible); empty disables streaming.")
+
+
+def _stream(event: Dict[str, Any]) -> None:
+    """Append one event to MXTPU_TELEMETRY_TRACE_PATH (lock held). A
+    failing stream path degrades to in-memory-only, once, loudly."""
+    global _stream_file, _stream_failed
+    if _stream_failed:
+        return
+    path = os.environ.get("MXTPU_TELEMETRY_TRACE_PATH", "")
+    if not path:
+        return
+    try:
+        if _stream_file is None or _stream_file.name != path:
+            if _stream_file is not None:
+                _stream_file.close()
+            _stream_file = open(path, "a", buffering=1)
+        # default=repr: span args are caller-supplied (numpy scalars,
+        # arbitrary objects) — a telemetry write must never raise into
+        # the instrumented code
+        _stream_file.write(json.dumps(event, default=repr) + "\n")
+    except Exception as e:
+        _stream_failed = True
+        import warnings
+        warnings.warn(f"telemetry trace stream to {path!r} failed "
+                      f"({e!r}); events stay in memory only",
+                      RuntimeWarning)
+
+
+def _record(event: Dict[str, Any]) -> None:
+    with _lock:
+        _events.append(event)
+        _stream(event)
+
+
+class Span:
+    """One traced duration (context manager). ``duration_ms`` is
+    populated on exit; ``args`` ride into the trace event verbatim."""
+
+    def __init__(self, name: str, histogram=None, flight=None,
+                 record: bool = True, **args: Any):
+        self.name = name
+        self.args = args
+        self.duration_ms: Optional[float] = None
+        self._histogram = histogram
+        self._flight = flight
+        self._record_event = record
+        self._t0 = 0
+
+    def __enter__(self) -> "Span":
+        depth = getattr(_tls, "depth", 0)
+        _tls.depth = depth + 1
+        self.depth = depth
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = _now_us()
+        _tls.depth = max(0, getattr(_tls, "depth", 1) - 1)
+        self.duration_ms = (t1 - self._t0) / 1000.0
+        args = dict(self.args)
+        if self.depth:
+            args["depth"] = self.depth
+        if self._record_event:
+            _record({"name": self.name, "ph": "X", "ts": self._t0,
+                     "dur": t1 - self._t0, "pid": os.getpid(),
+                     "tid": threading.get_ident(), "args": args})
+        if self._histogram is not None:
+            self._histogram.observe(self.duration_ms)
+        if self._flight is not None:
+            self._flight.record("span", self.name,
+                                dur_ms=round(self.duration_ms, 3),
+                                **self.args)
+        return False
+
+
+def span(name: str, histogram=None, flight=None, **args: Any) -> Span:
+    """``with telemetry.span("prefill", bucket=256): ...``"""
+    return Span(name, histogram=histogram, flight=flight, **args)
+
+
+def instant(name: str, **args: Any) -> None:
+    """An instant event (chrome ph='i')."""
+    _record({"name": name, "ph": "i", "ts": _now_us(), "s": "t",
+             "pid": os.getpid(), "tid": threading.get_ident(),
+             "args": args})
+
+
+def trace_events() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_events)
+
+
+def current_depth() -> int:
+    """This thread's open-span nesting depth."""
+    return getattr(_tls, "depth", 0)
+
+
+def dump_trace(path: str) -> str:
+    """Write the buffered events as a complete Trace Event Format JSON
+    array (one event per line — both valid JSON and diffable)."""
+    with _lock:
+        events = list(_events)
+    with open(path, "w") as f:
+        f.write("[\n")
+        f.write(",\n".join(json.dumps(e, default=repr)
+                           for e in events))
+        f.write("\n]\n")
+    return path
+
+
+def clear_trace() -> None:
+    global _stream_failed
+    with _lock:
+        _events.clear()
+        _stream_failed = False

@@ -408,3 +408,107 @@ def test_debug_nans_env_toggle():
         cwd=os.path.join(os.path.dirname(__file__), ".."))
     assert out.returncode == 0, out.stderr[-1000:]
     assert "NANS_ON" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5 satellites: profiler pause semantics + json dump, Monitor paths
+# ---------------------------------------------------------------------------
+def test_profiler_pause_keeps_trace_alive_and_gates_agg(tmp_path):
+    """pause() must suspend AGGREGATION only — the old `pause = stop`
+    aliasing tore down the XLA trace session, so a paused profile
+    could never resume its trace."""
+    from mxtpu import profiler as prof
+    mx.profiler.set_config(filename=str(tmp_path / "pk.json"))
+    mx.profiler.start()
+    (mx.nd.ones((2,)) * 2).wait_to_read()
+    mx.profiler.pause()
+    assert prof._state["running"] and prof._state["paused"]
+    # the region under pause is EXCLUDED from the aggregate
+    (mx.nd.ones((2,)) * 5).wait_to_read()
+    mx.profiler.resume()
+    assert not prof._state["paused"]
+    (mx.nd.ones((2,)) * 2).wait_to_read()
+    mx.profiler.stop()
+    assert not prof._state["running"]
+    row = [l for l in mx.profiler.dumps().splitlines()
+           if l.startswith("mul")][0]
+    assert int(row.split()[1]) == 2       # paused mul not counted
+    # pause when not running is a no-op, not an error
+    mx.profiler.pause()
+    assert not prof._state["paused"]
+
+
+def test_profiler_dumps_json_format(tmp_path):
+    import json
+    mx.profiler.set_config(filename=str(tmp_path / "pj.json"))
+    mx.profiler.start()
+    ((mx.nd.ones((3,)) * 2) + 1).wait_to_read()
+    mx.profiler.stop()
+    data = json.loads(mx.profiler.dumps(format="json"))
+    assert data["mul"]["count"] >= 1
+    assert data["mul"]["time_ms"] >= 0.0
+    assert json.loads(mx.profiler.dumps(format="json")) == data
+    with pytest.raises(ValueError):
+        mx.profiler.dumps(format="xml")
+    # reset=True clears the aggregate through the json path too
+    mx.profiler.dumps(format="json", reset=True)
+    assert json.loads(mx.profiler.dumps(format="json")) == {}
+
+
+def _fc_executor():
+    sym = mx.sym
+    data = sym.var("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = out.simple_bind(mx.cpu(), data=(2, 8))
+    ex.arg_dict["fc_weight"][:] = 1.0
+    return ex
+
+
+def test_monitor_pattern_sort_and_interval():
+    ex = _fc_executor()
+    mon = mx.monitor.Monitor(interval=2, pattern=".*weight.*",
+                             sort=True, monitor_all=True)
+    mon.install(ex)
+    mon.tic()                               # step 0: fires
+    ex.forward(is_train=False, data=mx.nd.ones((2, 8)))
+    stats = mon.toc()
+    names = [s[1] for s in stats]
+    assert names and names == sorted(names)
+    assert all("weight" in n for n in names)
+    assert "fc_output" not in names         # pattern filtered
+    mon.tic()                               # step 1: off-interval
+    ex.forward(is_train=False, data=mx.nd.ones((2, 8)))
+    assert mon.toc() == []
+    mon.tic()                               # step 2: fires again
+    ex.forward(is_train=False, data=mx.nd.ones((2, 8)))
+    assert mon.toc()
+
+
+def test_monitor_custom_stat_and_toc_print(capsys):
+    ex = _fc_executor()
+    mon = mx.monitor.Monitor(
+        interval=1, stat_func=lambda x: x.max(), monitor_all=False)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=mx.nd.ones((2, 8)))
+    mon.toc_print()
+    out = capsys.readouterr().out
+    assert "fc_output" in out and "Batch" in out
+    # outputs only (monitor_all=False): params not reported
+    assert "fc_weight" not in out
+
+
+def test_monitor_install_module():
+    mod = mx.mod.Module(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3,
+                              name="fcm"),
+        data_names=("data",), label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 5))], for_training=False)
+    mod.init_params()
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install_module(mod)
+    mon.tic()
+    mod.forward(mx.io.DataBatch(data=[mx.nd.ones((2, 5))]),
+                is_train=False)
+    stats = mon.toc()
+    assert any(name == "fcm_output" for _, name, _ in stats)

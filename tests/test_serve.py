@@ -296,6 +296,69 @@ def test_gluon_llama_serve(cfg, params):
     np.testing.assert_array_equal(res[rid], ref)
 
 
+def test_serve_telemetry_counters_spans_and_threads(cfg, params):
+    """ISSUE 5: the engine feeds the process-wide registry without
+    changing tokens, and the counters stay EXACT when two engines run
+    concurrently (token-callback threads + decode dispatch threads
+    hammering the same counter children)."""
+    from mxtpu import telemetry as tm
+    reg = tm.registry()
+    before_tok = reg.value("serve_tokens_total")
+    before_req = reg.value("serve_requests_total")
+    reqs = _poisson_requests(cfg, 6, seed=3, mixed_sampling=False)
+    results = {}
+
+    def run_one(idx):
+        streamed = []
+        local = [Request(prompt=r.prompt,
+                         max_new_tokens=r.max_new_tokens,
+                         temperature=r.temperature, seed=r.seed,
+                         arrival_step=r.arrival_step,
+                         on_token=lambda rid, tok:
+                             streamed.append((rid, tok)))
+                 for r in reqs]
+        eng = ServeEngine(cfg, params, max_slots=2, max_len=32,
+                          min_bucket=4)
+        rids = [eng.submit(r) for r in local]
+        res = eng.run()
+        results[idx] = ({rid: res[rid] for rid in rids}, streamed, eng)
+
+    threads = [__import__("threading").Thread(target=run_one,
+                                              args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert len(results) == 2
+    # scheduling/threading never changes tokens
+    for rid in results[0][0]:
+        np.testing.assert_array_equal(results[0][0][rid],
+                                      results[1][0][rid])
+    total_tokens = sum(len(v) for res, _, _ in results.values()
+                       for v in res.values())
+    assert reg.value("serve_tokens_total") - before_tok == total_tokens
+    assert reg.value("serve_requests_total") - before_req == 12
+    # per-engine latency stats from the private histogram
+    for _, streamed, eng in results.values():
+        lat = eng.latency_stats()
+        assert lat["n_gaps"] > 0
+        assert lat["p99_token_ms"] >= lat["p50_token_ms"] >= 0.0
+        eng.reset_stats()
+        assert eng.latency_stats()["n_gaps"] == 0
+    # admission waits and span histograms were fed
+    assert reg.get("serve_admission_wait_steps").count >= 12
+    assert reg.get("span_serve_decode_dispatch_ms").count > 0
+    assert reg.get("span_serve_prefill_ms").count >= 12
+    # churn through 2 slots never recompiled: the watcher agrees with
+    # the jit-cache gate (each engine compiles its own programs, so
+    # compile events == cache entries, and zero anomalies)
+    for _, _, eng in results.values():
+        assert len(eng._decode.compiles) == eng._decode._cache_size() \
+            == 1
+        assert reg.value("recompile_total", fn="serve_decode") == 0
+
+
 def test_serve_sharded_tp2_matches_single_device(cfg, params):
     """Sharded serving: the slot bank on a tp mesh (kv heads sharded)
     must reproduce the single-device engine's tokens."""

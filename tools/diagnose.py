@@ -1,10 +1,47 @@
 #!/usr/bin/env python
-"""Environment diagnostics (reference ``tools/diagnose.py``)."""
+"""Environment + runtime diagnostics (reference ``tools/diagnose.py``).
+
+Beyond the static environment report, prints the LIVE telemetry
+summary table and the flight-recorder tail — importable as
+``from tools.diagnose import report; report()`` inside a running job,
+where "what was this job doing" is answered by the last N recorded
+events. Standalone invocation also tails any on-disk flight dump left
+by a preempted/crashed process (``MXTPU_TELEMETRY_FLIGHT_PATH``).
+"""
+import json
 import os
 import platform
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def report(flight_tail: int = 20):
+    """The runtime half: telemetry summary + flight-recorder tail for
+    THIS process."""
+    from mxtpu import telemetry
+    print("----------Telemetry Summary----------")
+    print(telemetry.summary())
+    print(f"----------Flight Recorder (last {flight_tail})----------")
+    print(telemetry.flight().format_tail(flight_tail))
+
+
+def _tail_disk_dump(n: int = 20):
+    """A crashed process can't answer report() — but its flight dump
+    on disk can."""
+    path = os.environ.get("MXTPU_TELEMETRY_FLIGHT_PATH", "")
+    if not path or not os.path.exists(path):
+        return
+    print(f"----------On-disk flight dump ({path})----------")
+    with open(path) as f:
+        lines = f.readlines()[-n:]
+    for line in lines:
+        try:
+            evt = json.loads(line)
+        except ValueError:
+            print(line.rstrip())
+            continue
+        print(" ".join(f"{k}={v}" for k, v in evt.items()))
 
 
 def main():
@@ -20,6 +57,8 @@ def main():
     print("features:", mx.runtime.Features())
     from mxtpu import native
     print("libmxtpu native:", native.available())
+    report()
+    _tail_disk_dump()
 
 
 if __name__ == "__main__":
