@@ -292,6 +292,113 @@ def bench_llama_serve(n_requests=48, max_slots=16, max_len=768,
             "total_s": round(dt, 1), "vs_baseline": None}
 
 
+def bench_gateway(n_requests=32, n_replicas=2, max_slots=8,
+                  max_len=768, mean_interarrival_s=0.15, seed=0,
+                  cfg=None):
+    """Serving-TIER throughput + latency (ISSUE 6 tentpole): the same
+    ~500M config served through the multi-replica HTTP gateway
+    (``mxtpu.serve.gateway``) under a seeded OPEN-LOOP client stream —
+    arrivals fire on the wall clock regardless of completion (the
+    heavy-traffic regime: a closed loop would self-throttle and hide
+    queueing). Reports tok/s over generated tokens plus client-side
+    p50/p99 time-to-first-token AND inter-token latency — the two
+    numbers a serving SLO is written against."""
+    import threading as _threading
+    from mxtpu.models import llama
+    from mxtpu.serve import ServeEngine
+    from mxtpu.serve.gateway import Gateway, GatewayClient
+
+    cfg = cfg or llama.LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, hidden_dim=5632, max_seq_len=max_len,
+        remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    gw = Gateway(lambda: ServeEngine(cfg, params, max_slots=max_slots,
+                                     max_len=max_len,
+                                     min_bucket=max(4, max_len // 12)),
+                 n_replicas=n_replicas, queue_max=max(64, n_requests))
+    port = gw.start_http(port=0)
+    plens = [max_len // 12, max_len // 6, max_len // 3, max_len // 2]
+    try:
+        # warmup: every prefill bucket + the decode program on EVERY
+        # replica, outside the timed region (compile-then-measure
+        # discipline). Sequential warmups would all land on the first
+        # replica (least-loaded ties), so fire n_replicas CONCURRENT
+        # requests per bucket — while one replica holds a live slot,
+        # the router sends the next to a cold one.
+        warm = []
+
+        def _warm_one(prompt, j):
+            warm.append(GatewayClient("127.0.0.1", port).generate(
+                prompt, 8, seed=j))
+
+        for bi, p in enumerate(plens):
+            # prompts drawn on the main thread (rng is not thread-safe)
+            prompts = [rng.integers(0, cfg.vocab_size, p)
+                       for _ in range(n_replicas)]
+            ts = [_threading.Thread(target=_warm_one,
+                                    args=(prompts[k],
+                                          bi * n_replicas + k))
+                  for k in range(n_replicas)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        assert all(w["status"] == 200 for w in warm)
+
+        jobs = []
+        t_next = 0.0
+        for i in range(n_requests):
+            jobs.append(dict(
+                prompt=rng.integers(0, cfg.vocab_size,
+                                    int(rng.choice(plens))),
+                mnew=int(rng.integers(8, max_len // 3 + 1)),
+                at=t_next))
+            t_next += float(rng.exponential(mean_interarrival_s))
+        results = [None] * n_requests
+        t0 = time.perf_counter()
+
+        def fire(i, job):
+            delay = t0 + job["at"] - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            results[i] = GatewayClient("127.0.0.1", port).generate(
+                job["prompt"], job["mnew"], seed=i)
+
+        threads = [_threading.Thread(target=fire, args=(i, j))
+                   for i, j in enumerate(jobs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    finally:
+        gw.close()
+    ok = [r for r in results if r and r["status"] == 200]
+    total_new = sum(len(r["tokens"]) for r in ok)
+    ttfts = sorted(1e3 * (r["times"][0] - r["t0"])
+                   for r in ok if r["times"])
+    gaps = sorted(g for r in ok
+                  for g in (1e3 * np.diff(r["times"])
+                            if len(r["times"]) > 1 else []))
+
+    def pct(xs, q):
+        return round(float(xs[min(len(xs) - 1,
+                                  int(q / 100 * len(xs)))]), 2) \
+            if xs else 0.0
+
+    return {"metric": "llama_500m_gateway_tokens_per_s",
+            "value": round(total_new / dt, 1), "unit": "tok/s",
+            "ttft_p50_ms": pct(ttfts, 50),
+            "ttft_p99_ms": pct(ttfts, 99),
+            "p50_token_ms": pct(gaps, 50),
+            "p99_token_ms": pct(gaps, 99),
+            "n_requests": n_requests, "n_ok": len(ok),
+            "n_replicas": n_replicas, "max_slots": max_slots,
+            "total_s": round(dt, 1), "vs_baseline": None}
+
+
 def _on_cpu_mesh(impl_fn_name: str, n: int = 8):
     """Run ``bench.<impl_fn_name>()`` on an n-device virtual CPU mesh:
     directly when this process already is one, else via re-exec (same
@@ -731,6 +838,21 @@ def _gate_llama_serve():
             "batch": rec["max_slots"]}
 
 
+def _gate_gateway():
+    """Serving-tier gate: step_ms is mean ms per generated token
+    through the gateway under the seeded open-loop stream; TTFT and
+    inter-token percentiles ride along for the BENCH record."""
+    rec = bench_gateway()
+    total_new = max(1, round(rec["value"] * rec["total_s"]))
+    return {"step_ms": round(1000.0 * rec["total_s"] / total_new, 3),
+            "throughput": rec["value"], "unit": "tok/s",
+            "ttft_p50_ms": rec["ttft_p50_ms"],
+            "ttft_p99_ms": rec["ttft_p99_ms"],
+            "p50_token_ms": rec["p50_token_ms"],
+            "p99_token_ms": rec["p99_token_ms"],
+            "batch": rec["max_slots"] * rec["n_replicas"]}
+
+
 def _gate_smoke_llama():
     """CPU-safe tiny config — exercises the same measurement path so
     the gate plumbing is testable without a chip. Batch 8 so the dp
@@ -751,6 +873,7 @@ GATE_CONFIGS = {
     "llama_509m_decode": _gate_llama_decode,
     "llama_509m_decode_int8": lambda: _gate_llama_decode(int8=True),
     "llama_509m_serve": _gate_llama_serve,
+    "llama_509m_gateway": _gate_gateway,
     "smoke_llama": _gate_smoke_llama,
 }
 
@@ -853,7 +976,7 @@ def main_gate(argv):
 
     flagship = ["resnet50", "resnet50_s2d", "bert_base", "llama_509m",
                 "llama_509m_decode", "llama_509m_decode_int8",
-                "llama_509m_serve"]
+                "llama_509m_serve", "llama_509m_gateway"]
     if args.replay:
         with open(args.replay) as f:
             current = json.load(f)["configs"]
@@ -918,13 +1041,16 @@ def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
     if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b",
                     "aot8b_decode", "aot_moe", "aot8b_int8", "aot8b_32k",
-                    "input", "serve"):
+                    "input", "serve", "gateway"):
         raise SystemExit(
             "usage: bench.py [all|resnet|bert|llama|smoke|aot8b|"
             "aot8b_decode|aot_moe|aot8b_int8|aot8b_32k|input|serve|"
-            f"gate ...] (got {only!r})")
+            f"gateway|gate ...] (got {only!r})")
     if only == "serve":
         _emit(bench_llama_serve())
+        return
+    if only == "gateway":
+        _emit(bench_gateway())
         return
     if only == "smoke":
         _emit(bench_smoke_run())
@@ -983,6 +1109,7 @@ def main():
                        "value": round(q_s, 1), "unit": "tok/s",
                        "vs_baseline": None})
         extras.append(bench_llama_serve())
+        extras.append(bench_gateway())
     if only == "all":
         extras.append(bench_input_pipeline())
     out = {

@@ -140,6 +140,58 @@ print(f"serve_smoke: OK ({len(reqs)} requests, "
 PYEOF
 }
 
+gateway_smoke() {
+    # the serving TIER end to end in a fresh process (docs/serving.md
+    # §gateway): an HTTP gateway over one engine replica, one streamed
+    # request checked bit-identical against per-request generate, and
+    # a valid Prometheus scrape carrying the gateway gauges. The full
+    # contract (2 replicas, Poisson stream, backpressure, deadlines,
+    # disaggregated KV handoff, autoscaler) is tier-1 in
+    # tests/test_gateway.py; this proves the service path with no
+    # pytest fixtures.
+    python - << 'PYEOF'
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+import jax.numpy as jnp
+from dataclasses import replace
+from mxtpu.models import llama
+from mxtpu.serve import ServeEngine
+from mxtpu.serve.gateway import Gateway, GatewayClient
+
+cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32, remat=False,
+              attn_impl="dense")
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+gw = Gateway(lambda: ServeEngine(cfg, params, max_slots=2, max_len=32,
+                                 min_bucket=4), n_replicas=1)
+port = gw.start_http(port=0)
+cli = GatewayClient("127.0.0.1", port)
+rng = np.random.default_rng(13)
+prompt = rng.integers(0, cfg.vocab_size, 5)
+rec = cli.generate(prompt, 4, seed=2)
+assert rec["status"] == 200 and rec["reason"] == "complete", rec
+ref = llama.generate(cfg, params, jnp.asarray(prompt, jnp.int32)[None],
+                     4, rng=jax.random.PRNGKey(2))
+assert rec["tokens"] == [int(t) for t in np.asarray(ref)[0, 5:]], rec
+status, prom = cli.get_text("/metrics")
+assert status == 200
+for fam in ("mxtpu_gateway_replicas", "mxtpu_gateway_requests_total",
+            "mxtpu_gateway_ttft_ms", "mxtpu_serve_tokens_total"):
+    assert f"# TYPE {fam}" in prom, fam
+for line in prom.splitlines():
+    assert line.startswith("#") or " " in line, line
+status, state = cli.get_json("/state")
+assert status == 200 and state["n_replicas"] == 1, state
+gw.close()
+print(f"gateway_smoke: OK (4 streamed tokens bit-identical, "
+      f"{len(prom.splitlines())} metric lines, "
+      f"{len(state['replicas'])} replica)")
+PYEOF
+}
+
 telemetry_smoke() {
     # the observability layer end to end in a fresh process on the
     # ENABLED-BY-DEFAULT path (docs/observability.md): metrics through
@@ -298,7 +350,7 @@ bench_gate_baseline() {
     # box, then commit the json — intentional-change workflow, the
     # sibling of opperf_baseline)
     python bench.py gate --update \
-        --configs resnet50,resnet50_s2d,bert_base,llama_509m,llama_509m_decode,llama_509m_decode_int8,llama_509m_serve
+        --configs resnet50,resnet50_s2d,bert_base,llama_509m,llama_509m_decode,llama_509m_decode_int8,llama_509m_serve,llama_509m_gateway
     echo "bench_gate_baseline: wrote benchmark/baseline_models.json"
 }
 
@@ -318,6 +370,7 @@ ci_all() {
     multichip_dryrun
     bench_smoke
     serve_smoke
+    gateway_smoke
     telemetry_smoke
     opperf_coverage
     bench_gate
@@ -333,6 +386,7 @@ ci_fast() {
     unittest_fast
     bench_smoke
     serve_smoke
+    gateway_smoke
     telemetry_smoke
 }
 

@@ -69,39 +69,30 @@ applying ``sgd_update`` on aggregated grads.
 """
 from __future__ import annotations
 
-import hashlib
-import hmac as hmac_mod
 import os
 import pickle
 import socket
-import struct
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as onp
 
-from .. import telemetry
+from .. import rpc, telemetry
 from ..base import (MXNetError, atomic_write, env_float, env_int, env_str)
 
 __all__ = ["KVStoreServer", "ServerClient", "server_address",
            "PSAuthError", "PSProtocolError"]
 
-_LEN = struct.Struct("<Q")
-_I = struct.Struct("<q")
-_F = struct.Struct("<d")
-_U32 = struct.Struct("<I")
-
-
-class PSAuthError(ConnectionError):
-    """A frame failed HMAC verification — secret mismatch, not a
-    transient network fault. Never retried: retrying an auth failure
-    can only fail the same way until the deadline."""
-
-
-class PSProtocolError(ConnectionError):
-    """The peer sent bytes that are not this protocol (foreign service
-    on the port, torn frame). Never retried."""
+# The PS wire layer IS the shared framed-RPC layer (mxtpu/rpc.py —
+# factored out of this file so the serving gateway's KV-handoff channel
+# speaks the same codec). The names below are the original PS-side
+# spellings, kept because tests and operators know them.
+PSAuthError = rpc.RPCAuthError
+PSProtocolError = rpc.RPCProtocolError
+_enc = rpc._enc
+_dec = rpc._dec
+_MAC = rpc.MAC_SIZE
 
 
 def server_address() -> tuple:
@@ -117,167 +108,21 @@ def _wire_secret() -> bytes:
     return os.environ.get("MXTPU_PS_SECRET", "").encode()
 
 
-# ---- safe codec: tags + struct headers + raw buffers (no pickle) ----
-_T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_FLOAT, _T_STR, _T_BYTES, \
-    _T_TUPLE, _T_LIST, _T_ARR = range(10)
-
-
-def _enc(obj: Any, out: bytearray) -> None:
-    if obj is None:
-        out.append(_T_NONE)
-    elif obj is True:
-        out.append(_T_TRUE)
-    elif obj is False:
-        out.append(_T_FALSE)
-    elif isinstance(obj, (int, onp.integer)):
-        out.append(_T_INT)
-        out += _I.pack(int(obj))
-    elif isinstance(obj, (float, onp.floating)):
-        out.append(_T_FLOAT)
-        out += _F.pack(float(obj))
-    elif isinstance(obj, str):
-        b = obj.encode()
-        out.append(_T_STR)
-        out += _U32.pack(len(b)) + b
-    elif isinstance(obj, (bytes, bytearray)):
-        out.append(_T_BYTES)
-        out += _U32.pack(len(obj)) + obj
-    elif isinstance(obj, tuple):
-        out.append(_T_TUPLE)
-        out += _U32.pack(len(obj))
-        for x in obj:
-            _enc(x, out)
-    elif isinstance(obj, list):
-        out.append(_T_LIST)
-        out += _U32.pack(len(obj))
-        for x in obj:
-            _enc(x, out)
-    elif isinstance(obj, onp.ndarray):
-        a = onp.asarray(obj)    # tobytes() C-orders; NOT
-        # ascontiguousarray, which promotes 0-d to 1-d
-        if a.dtype.hasobject:
-            raise TypeError("object arrays are not wire-safe")
-        dt = a.dtype.str.encode()    # e.g. b'<f4'
-        out.append(_T_ARR)
-        out += _U32.pack(len(dt)) + dt
-        out += _U32.pack(a.ndim)
-        for d in a.shape:
-            out += _I.pack(d)
-        raw = a.tobytes()
-        out += _LEN.pack(len(raw)) + raw
-    else:
-        raise TypeError(f"type {type(obj).__name__} is not wire-safe")
-
-
-def _dec(buf: memoryview, pos: int):
-    tag = buf[pos]
-    pos += 1
-    if tag == _T_NONE:
-        return None, pos
-    if tag == _T_TRUE:
-        return True, pos
-    if tag == _T_FALSE:
-        return False, pos
-    if tag == _T_INT:
-        return _I.unpack_from(buf, pos)[0], pos + 8
-    if tag == _T_FLOAT:
-        return _F.unpack_from(buf, pos)[0], pos + 8
-    if tag in (_T_STR, _T_BYTES):
-        (n,) = _U32.unpack_from(buf, pos)
-        pos += 4
-        raw = bytes(buf[pos:pos + n])
-        return (raw.decode() if tag == _T_STR else raw), pos + n
-    if tag in (_T_TUPLE, _T_LIST):
-        (n,) = _U32.unpack_from(buf, pos)
-        pos += 4
-        items = []
-        for _ in range(n):
-            x, pos = _dec(buf, pos)
-            items.append(x)
-        return (tuple(items) if tag == _T_TUPLE else items), pos
-    if tag == _T_ARR:
-        (nd,) = _U32.unpack_from(buf, pos)
-        pos += 4
-        dt = onp.dtype(bytes(buf[pos:pos + nd]).decode())
-        if dt.hasobject:
-            raise PSProtocolError("object dtype on the wire")
-        pos += nd
-        (ndim,) = _U32.unpack_from(buf, pos)
-        pos += 4
-        shape = []
-        for _ in range(ndim):
-            shape.append(_I.unpack_from(buf, pos)[0])
-            pos += 8
-        (nraw,) = _LEN.unpack_from(buf, pos)
-        pos += 8
-        a = onp.frombuffer(bytes(buf[pos:pos + nraw]),
-                           dtype=dt).reshape(shape)
-        return a, pos + nraw
-    raise PSProtocolError(f"bad wire tag {tag} — foreign protocol")
-
-
-_MAX_FRAME = 1 << 33    # 8 GB: anything larger is a foreign protocol
-_MAC = hashlib.sha256().digest_size
-
-
 def _send_msg(sock: socket.socket, obj: Any,
               secret: Optional[bytes] = None) -> None:
-    out = bytearray()
-    _enc(obj, out)
-    if secret is None:
-        secret = _wire_secret()
-    mac = (hmac_mod.new(secret, bytes(out), hashlib.sha256).digest()
-           if secret else b"")
-    sock.sendall(_LEN.pack(len(out) + len(mac)) + mac + out)
+    """PS-flavored :func:`mxtpu.rpc.send_msg`: ``secret=None`` means
+    "the ambient MXTPU_PS_SECRET" (the rpc layer itself takes an
+    explicit secret — b'' disables auth there)."""
+    rpc.send_msg(sock, obj, _wire_secret() if secret is None else secret)
 
 
 def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None,
               observe=None):
-    """Returns (message, authenticated: bool). ``observe``, when set,
-    is called with the frame's byte length (the server feeds its
-    request-size histogram through it; decode errors still count —
-    an oversized foreign frame is exactly what the histogram should
-    show)."""
-    hdr = b""
-    while len(hdr) < _LEN.size:
-        chunk = sock.recv(_LEN.size - len(hdr))
-        if not chunk:
-            raise ConnectionError("kvstore server connection closed")
-        hdr += chunk
-    (n,) = _LEN.unpack(hdr)
-    if observe is not None:
-        observe(n)
-    if n > _MAX_FRAME:
-        raise PSProtocolError(
-            f"implausible frame length {n} — peer is not an mxtpu "
-            "kvstore server")
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("kvstore server connection closed")
-        buf += chunk
-    if secret is None:
-        secret = _wire_secret()
-    authed = False
-    if secret:
-        if n < _MAC or not hmac_mod.compare_digest(
-                hmac_mod.new(secret, bytes(buf[_MAC:]),
-                             hashlib.sha256).digest(), bytes(buf[:_MAC])):
-            raise PSAuthError("kvstore frame failed HMAC check")
-        buf = buf[_MAC:]
-        authed = True
-    try:
-        msg, pos = _dec(memoryview(buf), 0)
-    except ConnectionError:
-        raise
-    except Exception as e:    # struct.error / TypeError / ValueError
-        # from malformed bytes: reject as a protocol error, never let
-        # a foreign frame crash the serving thread
-        raise PSProtocolError(f"malformed kvstore frame ({e})") from e
-    if pos != len(buf):
-        raise PSProtocolError("trailing bytes in kvstore frame")
-    return msg, authed
+    """Returns (message, authenticated: bool); see
+    :func:`mxtpu.rpc.recv_msg` (frame-size ceiling, HMAC check, safe
+    decode all live there now)."""
+    return rpc.recv_msg(sock, _wire_secret() if secret is None
+                        else secret, observe=observe)
 
 
 # ops that change server state — they trigger snapshots and MUST ride

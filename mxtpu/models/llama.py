@@ -46,7 +46,8 @@ __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "chunked_prefill", "decode_step", "generate",
            "quantize_params_int8", "int8_sharding_rules",
            "sample_logits", "init_slot_cache", "slot_cache_specs",
-           "prefill_slot", "decode_slots"]
+           "prefill_slot", "decode_slots", "prefill_detached",
+           "inject_slot_kv"]
 
 
 @dataclass(frozen=True)
@@ -1190,3 +1191,91 @@ def prefill_slot(cfg: LlamaConfig, params, tokens, true_len, slot,
             for n, a in new_sv.items()}
         tok = _mcon(mesh, tok, None)
     return tok, new_kv, new_sv
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode (DistServe, OSDI '24): prefill is
+# compute-bound, decode is memory-bound — the serving gateway runs them
+# on separate worker pools with a KV handoff in between. The two
+# programs below are that handoff's device halves: ``prefill_detached``
+# is ``prefill_slot`` minus the slot bank (it RETURNS the per-request
+# KV block instead of scattering it), and ``inject_slot_kv`` is the
+# scatter alone, run later on the decode worker's bank. Same forward
+# graph, same sampler, same rng chain — so a prefill→handoff→decode
+# request is bit-identical to the colocated path (tier-1-gated in
+# tests/test_gateway.py).
+# ---------------------------------------------------------------------------
+
+def prefill_detached(cfg: LlamaConfig, params, tokens, true_len, rng,
+                     temperature, top_k, top_p,
+                     mesh: Optional[Mesh] = None):
+    """Prefill ONE request without a slot bank: run the END-padded
+    prompt (see :func:`prefill_slot` for why end padding is exact)
+    through the cached stack and return the pieces a decode worker
+    needs — ``(first_token (1,), k_block, v_block, new_rng)`` with
+    k/v blocks shaped (L, n_kv_heads, bucket, hd). One compiled
+    program per prompt bucket, exactly like ``prefill_slot``."""
+    b, bucket = tokens.shape
+    hd = cfg.head_dim
+    tmp = {"k": jnp.zeros((cfg.n_layers, b, cfg.n_kv_heads, bucket,
+                           hd), cfg.dtype),
+           "v": jnp.zeros((cfg.n_layers, b, cfg.n_kv_heads, bucket,
+                           hd), cfg.dtype),
+           "pos": jnp.zeros((), jnp.int32)}
+    true_len = jnp.asarray(true_len, jnp.int32)
+    logits, tmp = _forward_cached(cfg, params, tokens, tmp, mesh=mesh,
+                                  last_index=true_len - 1)
+    rng, sub = jax.random.split(rng)
+    tok = sample_logits(sub, logits[:, 0], temperature=temperature,
+                        top_k=top_k, top_p=top_p)
+    k_block, v_block = tmp["k"][:, 0], tmp["v"][:, 0]
+    if mesh is not None:
+        # the block leaves the device for the wire — replicate it so
+        # the host gather is one copy, not a reshard
+        tok = _mcon(mesh, tok, None)
+        k_block = _mcon(mesh, k_block, None, None, None, None)
+        v_block = _mcon(mesh, v_block, None, None, None, None)
+    return tok, k_block, v_block, rng
+
+
+def inject_slot_kv(cfg: LlamaConfig, k_block, v_block, true_len, slot,
+                   token, rng, kv, sv, mesh: Optional[Mesh] = None):
+    """Decode-side admission of a handed-off prefill: write the
+    (L, n_kv_heads, bucket, hd) KV block into row ``slot`` of the slot
+    bank and seed the slot's length/token/rng — the scatter half of
+    :func:`prefill_slot`, with the forward pass already paid on the
+    prefill pool. Pad K/V beyond ``true_len`` are excluded by the
+    slot length mask and overwritten before the length reaches them
+    (same argument as bucketed prefill). One compiled program per
+    block bucket; kv is donatable. Returns (new_kv, new_sv)."""
+    true_len = jnp.asarray(true_len, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    token = jnp.asarray(token, jnp.int32)
+    z = jnp.zeros((), jnp.int32)
+    new_kv = {
+        "k": lax.dynamic_update_slice(
+            kv["k"], k_block[:, None].astype(kv["k"].dtype),
+            (z, slot, z, z, z)),
+        "v": lax.dynamic_update_slice(
+            kv["v"], v_block[:, None].astype(kv["v"].dtype),
+            (z, slot, z, z, z)),
+    }
+    new_sv = {
+        "lengths": lax.dynamic_update_slice(
+            sv["lengths"].astype(jnp.int32), true_len[None], (slot,)),
+        "tokens": lax.dynamic_update_slice(
+            sv["tokens"], token[None].astype(sv["tokens"].dtype),
+            (slot,)),
+        "rngs": lax.dynamic_update_slice(
+            sv["rngs"], rng[None].astype(sv["rngs"].dtype), (slot, z)),
+    }
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        specs = slot_cache_specs(cfg, mesh)
+        new_kv = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n]))
+            for n, a in new_kv.items()}
+        new_sv = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n]))
+            for n, a in new_sv.items()}
+    return new_kv, new_sv

@@ -1,0 +1,271 @@
+"""Framed-RPC wire layer — the ONE codec every mxtpu socket protocol
+speaks (factored out of ``kvstore/server.py``, where it grew up
+carrying parameter pushes; the serving gateway's KV-handoff channel is
+the second consumer — see ``mxtpu/serve/gateway/disagg.py``).
+
+Design, unchanged from the kvstore original:
+
+- **Length-prefixed frames** carrying a SAFE tag-based binary encoding
+  (struct headers + raw numpy bytes) — NOT pickle, so a foreign peer
+  can never achieve code execution by connecting to a port that speaks
+  this protocol. Opaque ``bytes`` payloads may ride inside a frame;
+  whether to unpickle one is the CALLER's trust decision (the kvstore
+  only does it for authenticated or loopback peers).
+- **HMAC-SHA256 authentication** when a ``secret`` is supplied: the
+  digest prefixes the body inside the length frame, verified on
+  receive with a constant-time compare. Integrity + peer
+  authentication only — no nonce, so an on-path attacker can replay
+  captured frames; run an encrypted transport underneath on untrusted
+  networks.
+- **Frame-size ceiling**: a length header beyond
+  ``MXTPU_RPC_MAX_FRAME`` (default 8 GB) is rejected as a foreign
+  protocol before any allocation — the knob exists because the right
+  bound is deployment-specific: a KV-handoff channel moving multi-GB
+  cache blocks wants the ceiling high, a control plane on an exposed
+  port wants it tight.
+
+Errors: :class:`RPCAuthError` (secret mismatch — never retry) and
+:class:`RPCProtocolError` (foreign/torn bytes — never retry), both
+``ConnectionError`` subclasses so transport-level retry loops that
+catch ``ConnectionError`` broadly must list them FIRST to fail fast.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import socket
+import struct
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as onp
+
+from .base import env_int
+
+__all__ = ["RPCAuthError", "RPCProtocolError", "encode", "decode",
+           "send_msg", "recv_msg", "max_frame_bytes", "MAC_SIZE"]
+
+_LEN = struct.Struct("<Q")
+_I = struct.Struct("<q")
+_F = struct.Struct("<d")
+_U32 = struct.Struct("<I")
+
+MAC_SIZE = hashlib.sha256().digest_size
+
+
+class RPCAuthError(ConnectionError):
+    """A frame failed HMAC verification — secret mismatch, not a
+    transient network fault. Never retried: retrying an auth failure
+    can only fail the same way until the deadline."""
+
+
+class RPCProtocolError(ConnectionError):
+    """The peer sent bytes that are not this protocol (foreign service
+    on the port, torn frame). Never retried."""
+
+
+def max_frame_bytes() -> int:
+    """The inbound frame-size ceiling. Read per call so a test (or an
+    operator mid-incident) can tighten it without rebuilding sockets."""
+    return env_int(
+        "MXTPU_RPC_MAX_FRAME", 1 << 33,
+        "Maximum inbound framed-RPC message size in bytes (kvstore "
+        "wire + gateway KV handoff); larger length headers are "
+        "rejected as a foreign protocol before allocation.")
+
+
+# ---- safe codec: tags + struct headers + raw buffers (no pickle) ----
+_T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_FLOAT, _T_STR, _T_BYTES, \
+    _T_TUPLE, _T_LIST, _T_ARR = range(10)
+
+
+def _decode_dtype(s: str) -> onp.dtype:
+    """Resolve a wire dtype string: struct codes ('<f4') directly,
+    named extension dtypes ('bfloat16') after making sure ml_dtypes
+    has registered them with numpy (a frame may arrive before the
+    receiver ever imported jax)."""
+    try:
+        return onp.dtype(s)
+    except TypeError:
+        try:
+            import ml_dtypes  # noqa: F401  (registers named dtypes)
+            return onp.dtype(s)
+        except (ImportError, TypeError):
+            raise RPCProtocolError(f"unknown wire dtype {s!r}")
+
+
+def _enc(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(_T_NONE)
+    elif obj is True:
+        out.append(_T_TRUE)
+    elif obj is False:
+        out.append(_T_FALSE)
+    elif isinstance(obj, (int, onp.integer)):
+        out.append(_T_INT)
+        out += _I.pack(int(obj))
+    elif isinstance(obj, (float, onp.floating)):
+        out.append(_T_FLOAT)
+        out += _F.pack(float(obj))
+    elif isinstance(obj, str):
+        b = obj.encode()
+        out.append(_T_STR)
+        out += _U32.pack(len(b)) + b
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(_T_BYTES)
+        out += _U32.pack(len(obj)) + obj
+    elif isinstance(obj, tuple):
+        out.append(_T_TUPLE)
+        out += _U32.pack(len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, list):
+        out.append(_T_LIST)
+        out += _U32.pack(len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, onp.ndarray):
+        a = onp.asarray(obj)    # tobytes() C-orders; NOT
+        # ascontiguousarray, which promotes 0-d to 1-d
+        if a.dtype.hasobject:
+            raise TypeError("object arrays are not wire-safe")
+        if a.dtype.kind == "V":
+            # ml_dtypes extension dtypes (bfloat16, float8_*) map to
+            # raw void in dtype.str — ship the NAME instead, which
+            # onp.dtype() resolves back once ml_dtypes is registered
+            # (bf16 KV blocks are the gateway handoff's default).
+            # Structured/void arrays stay refused.
+            if a.dtype.names is not None or a.dtype.name.startswith(
+                    "void"):
+                raise TypeError("structured arrays are not wire-safe")
+            dt = a.dtype.name.encode()   # e.g. b'bfloat16'
+        else:
+            dt = a.dtype.str.encode()    # e.g. b'<f4'
+        out.append(_T_ARR)
+        out += _U32.pack(len(dt)) + dt
+        out += _U32.pack(a.ndim)
+        for d in a.shape:
+            out += _I.pack(d)
+        raw = a.tobytes()
+        out += _LEN.pack(len(raw)) + raw
+    else:
+        raise TypeError(f"type {type(obj).__name__} is not wire-safe")
+
+
+def _dec(buf: memoryview, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_INT:
+        return _I.unpack_from(buf, pos)[0], pos + 8
+    if tag == _T_FLOAT:
+        return _F.unpack_from(buf, pos)[0], pos + 8
+    if tag in (_T_STR, _T_BYTES):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        raw = bytes(buf[pos:pos + n])
+        return (raw.decode() if tag == _T_STR else raw), pos + n
+    if tag in (_T_TUPLE, _T_LIST):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            x, pos = _dec(buf, pos)
+            items.append(x)
+        return (tuple(items) if tag == _T_TUPLE else items), pos
+    if tag == _T_ARR:
+        (nd,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        dt = _decode_dtype(bytes(buf[pos:pos + nd]).decode())
+        if dt.hasobject:
+            raise RPCProtocolError("object dtype on the wire")
+        pos += nd
+        (ndim,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        shape = []
+        for _ in range(ndim):
+            shape.append(_I.unpack_from(buf, pos)[0])
+            pos += 8
+        (nraw,) = _LEN.unpack_from(buf, pos)
+        pos += 8
+        a = onp.frombuffer(bytes(buf[pos:pos + nraw]),
+                           dtype=dt).reshape(shape)
+        return a, pos + nraw
+    raise RPCProtocolError(f"bad wire tag {tag} — foreign protocol")
+
+
+def encode(obj: Any) -> bytearray:
+    """Encode one message body (no length prefix, no MAC)."""
+    out = bytearray()
+    _enc(obj, out)
+    return out
+
+
+def decode(buf: bytes) -> Any:
+    """Decode one full message body; trailing bytes are a protocol
+    error (a truncated or concatenated frame must never half-parse)."""
+    try:
+        msg, pos = _dec(memoryview(buf), 0)
+    except ConnectionError:
+        raise
+    except Exception as e:    # struct.error / TypeError / ValueError
+        # from malformed bytes: reject as a protocol error, never let
+        # a foreign frame crash the serving thread
+        raise RPCProtocolError(f"malformed rpc frame ({e})") from e
+    if pos != len(buf):
+        raise RPCProtocolError("trailing bytes in rpc frame")
+    return msg
+
+
+def send_msg(sock: socket.socket, obj: Any, secret: bytes = b"") -> int:
+    """Frame + (optionally) authenticate + send one message. Returns
+    the frame payload size in bytes (callers feed size histograms)."""
+    out = encode(obj)
+    mac = (_hmac.new(secret, bytes(out), hashlib.sha256).digest()
+           if secret else b"")
+    n = len(out) + len(mac)
+    sock.sendall(_LEN.pack(n) + mac + out)
+    return n
+
+
+def recv_msg(sock: socket.socket, secret: bytes = b"",
+             observe: Optional[Callable[[int], None]] = None
+             ) -> Tuple[Any, bool]:
+    """Receive one frame. Returns (message, authenticated). ``observe``,
+    when set, is called with the frame's byte length (servers feed
+    request-size histograms through it; decode errors still count — an
+    oversized foreign frame is exactly what the histogram should
+    show)."""
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer connection closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    if observe is not None:
+        observe(n)
+    if n > max_frame_bytes():
+        raise RPCProtocolError(
+            f"implausible frame length {n} > MXTPU_RPC_MAX_FRAME "
+            f"{max_frame_bytes()} — peer is not an mxtpu rpc endpoint")
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer connection closed")
+        buf += chunk
+    authed = False
+    if secret:
+        if n < MAC_SIZE or not _hmac.compare_digest(
+                _hmac.new(secret, bytes(buf[MAC_SIZE:]),
+                          hashlib.sha256).digest(),
+                bytes(buf[:MAC_SIZE])):
+            raise RPCAuthError("rpc frame failed HMAC check")
+        buf = buf[MAC_SIZE:]
+        authed = True
+    return decode(bytes(buf)), authed

@@ -15,7 +15,21 @@ prefill-bucket count + 1.
     results = eng.run()          # {rid: np.ndarray of generated tokens}
 
 Or from the Gluon surface: ``net.serve(...)`` on a ``GluonLlama``.
-"""
-from .engine import Request, ServeEngine, bucket_for
 
-__all__ = ["Request", "ServeEngine", "bucket_for"]
+The multi-replica serving SERVICE over this engine — HTTP front door,
+replica routing, disaggregated prefill/decode, autoscaling — lives in
+``mxtpu.serve.gateway`` (imported lazily: the engine alone must not
+pay for the gateway stack).
+"""
+from .engine import KVHandoff, Request, ServeEngine, bucket_for
+
+__all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for",
+           "gateway"]
+
+
+def __getattr__(name):
+    if name == "gateway":
+        import importlib
+        return importlib.import_module(".gateway", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

@@ -40,6 +40,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import os
+import threading
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -51,7 +52,7 @@ import numpy as np
 from .. import telemetry
 from ..models import llama
 
-__all__ = ["Request", "ServeEngine", "bucket_for"]
+__all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for"]
 
 # admission wait is measured in engine steps (arrival → slot grant)
 _WAIT_STEP_BUCKETS = (0.0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 1024)
@@ -118,7 +119,14 @@ class Request:
     as ``rng=PRNGKey(seed)``). ``arrival_step`` delays admission until
     that engine step — the hook seeded arrival streams (bench, tests)
     use. ``on_token(rid, token)`` streams tokens as they are
-    produced."""
+    produced; ``on_done(rid, reason)`` fires exactly once per request
+    with reason ``"complete"``, ``"cancel"``/other explicit
+    :meth:`ServeEngine.cancel` reasons, or ``"deadline"``.
+    ``deadline_s`` is a RELATIVE budget on the engine's clock: a
+    request still running (or still queued) that many seconds after
+    ``submit`` is cancelled at the next step boundary — the gateway's
+    slow-client defense (a stalled consumer must not hold a slot
+    forever)."""
     prompt: Any
     max_new_tokens: int
     temperature: float = 0.0
@@ -127,6 +135,23 @@ class Request:
     seed: int = 0
     arrival_step: int = 0
     on_token: Optional[Callable[[int, int], None]] = None
+    on_done: Optional[Callable[[int, str], None]] = None
+    deadline_s: Optional[float] = None
+
+
+@dataclass
+class KVHandoff:
+    """A prefill worker's detached output — everything a decode engine
+    needs to seat the request without re-running the prompt
+    (``llama.prefill_detached`` produces it, ``llama.inject_slot_kv``
+    consumes it). ``k``/``v``: (L, n_kv_heads, bucket, hd) host
+    arrays; ``rng``: the (2,) uint32 chain state AFTER the first-token
+    split, so decode continues the exact chain ``generate`` would."""
+    k: np.ndarray
+    v: np.ndarray
+    true_len: int
+    token: int
+    rng: np.ndarray
 
 
 @dataclass
@@ -153,10 +178,14 @@ class ServeEngine:
     def __init__(self, cfg, params, *, max_slots: Optional[int] = None,
                  max_len: Optional[int] = None,
                  min_bucket: Optional[int] = None,
-                 mesh=None, overlap: Optional[bool] = None):
+                 mesh=None, overlap: Optional[bool] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
+        # deadlines are measured on THIS clock (monotonic seconds);
+        # injectable so deadline/autoscale tests are deterministic
+        self._clock = clock or time.monotonic
         self.max_slots = (max_slots if max_slots is not None
                           else _env_int("MXTPU_SERVE_MAX_SLOTS", 8))
         self.max_len = int(max_len or cfg.max_seq_len)
@@ -180,7 +209,9 @@ class ServeEngine:
                     donate_argnums=(1,)),
             "serve_decode", expected=1)
         self._prefills: Dict[int, Any] = {}
+        self._injects: Dict[int, Any] = {}
         self._m = _engine_metrics()
+        self._m_cancel: Dict[str, Any] = {}    # per-reason counters
         # span factories pre-bind their registry histograms — the
         # per-step/per-admission hot paths must not re-intern handles
         self._span_decode = telemetry.span_factory(
@@ -199,18 +230,33 @@ class ServeEngine:
         self._topps = np.ones(S, np.float32)
         self._slot_rid: List[Optional[int]] = [None] * S
 
+        # batch mode (run()) returns the per-request token lists, so
+        # it must retain them; a long-lived gateway replica must NOT —
+        # EngineReplica flips this off so request bookkeeping is
+        # pruned at finalize instead of growing for the process life
+        self.retain_results = True
         self._queue: List[Tuple[int, int, Request]] = []   # heap
         self._requests: Dict[int, Request] = {}
         self._results: Dict[int, List[int]] = {}
         self._done: Dict[int, bool] = {}
+        self._handoffs: Dict[int, KVHandoff] = {}
+        self._cancelled: Dict[int, str] = {}   # rid -> pending reason
+        self._deadlines: Dict[int, float] = {}  # rid -> absolute clock
+        self._ended: Dict[int, str] = {}       # rid -> final reason
         self._next_rid = 0
         self._step_idx = 0
         self.steps_run = 0
+        # submit()/cancel() may run on gateway threads while the
+        # engine loop steps; the lock guards the request-table state,
+        # the condition wakes an idle run_forever on new work
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
 
     # -- submission ----------------------------------------------------------
     def submit(self, request: Request) -> int:
         """Queue a request; returns its id. Validation mirrors
-        ``generate``'s."""
+        ``generate``'s. Thread-safe (gateway threads submit while the
+        engine loop runs)."""
         prompt = np.asarray(request.prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -228,21 +274,138 @@ class ServeEngine:
         if request.top_p is not None and not 0.0 < request.top_p <= 1.0:
             raise ValueError(
                 f"top_p must be in (0, 1], got {request.top_p}")
-        rid = self._next_rid
-        self._next_rid += 1
-        self._requests[rid] = request
-        self._results[rid] = []
-        self._done[rid] = False
-        heapq.heappush(self._queue,
-                       (int(request.arrival_step), rid, request))
-        self._m["requests"].inc()
-        self._m["queue"].set(len(self._queue))
+        return self._enqueue(request)
+
+    def submit_prefilled(self, handoff: KVHandoff,
+                         request: Request) -> int:
+        """Queue a request whose prompt was already prefilled on a
+        prefill worker (disaggregated mode): admission seats the
+        handed-off KV block via ``llama.inject_slot_kv`` instead of
+        running a prefill program, and the worker-sampled first token
+        is emitted as this request's first token."""
+        if handoff.true_len < 1:
+            raise ValueError("empty handoff")
+        if handoff.true_len + request.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({handoff.true_len}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_len "
+                f"{self.max_len}")
+        if handoff.k.shape[2] > self.max_len:
+            raise ValueError(
+                f"handoff bucket {handoff.k.shape[2]} exceeds max_len "
+                f"{self.max_len}")
+        return self._enqueue(request, handoff=handoff)
+
+    def _enqueue(self, request: Request,
+                 handoff: Optional[KVHandoff] = None) -> int:
+        with self._cv:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._requests[rid] = request
+            self._results[rid] = []
+            self._done[rid] = False
+            if handoff is not None:
+                self._handoffs[rid] = handoff
+            if request.deadline_s is not None:
+                self._deadlines[rid] = (self._clock()
+                                        + float(request.deadline_s))
+            heapq.heappush(self._queue,
+                           (int(request.arrival_step), rid, request))
+            self._m["requests"].inc()
+            self._m["queue"].set(len(self._queue))
+            self._cv.notify_all()
         return rid
 
+    # -- cancellation / deadlines --------------------------------------------
+    def cancel(self, rid: int, reason: str = "cancel") -> bool:
+        """Request cancellation: the rid's slot is freed at the NEXT
+        step boundary (a queued rid is finalized without ever taking a
+        slot) and ``serve_cancelled_total{reason}`` increments. Returns
+        False if the rid is unknown or already finished."""
+        with self._cv:
+            if rid not in self._requests or rid in self._ended \
+                    or self._done.get(rid):
+                return False
+            self._cancelled.setdefault(rid, reason)
+            self._cv.notify_all()
+        return True
+
+    def _cancel_counter(self, reason: str):
+        m = self._m_cancel.get(reason)
+        if m is None:
+            m = self._m_cancel[reason] = telemetry.counter(
+                "serve_cancelled_total",
+                "Requests ended before completion, by reason",
+                reason=reason)
+        return m
+
+    def _finalize(self, rid: int, reason: str) -> None:
+        """Exactly-once request teardown (lock held): final reason,
+        cancel accounting, the on_done callback, and — with
+        ``retain_results`` off — pruning, so a forever-serving replica
+        stays O(live requests), not O(all requests ever)."""
+        if rid in self._ended:
+            return
+        self._ended[rid] = reason
+        self._done[rid] = True
+        self._deadlines.pop(rid, None)
+        self._handoffs.pop(rid, None)
+        self._last_tok.pop(rid, None)
+        # always pruned: a stale entry here would also permanently
+        # defeat _sweep_cancelled's empty-dict fast path
+        self._cancelled.pop(rid, None)
+        if reason != "complete":
+            self._cancel_counter(reason).inc()
+            telemetry.flight().record("serve", "cancelled", rid=rid,
+                                      reason=reason)
+        req = self._requests[rid]
+        if req.on_done is not None:
+            req.on_done(rid, reason)
+        if not self.retain_results:
+            self._requests.pop(rid, None)
+            self._results.pop(rid, None)
+            self._done.pop(rid, None)
+            if rid in self._slot_rid:
+                # seated: its heap entry was consumed at admission, so
+                # nothing else will reap the tombstone
+                self._ended.pop(rid, None)
+            # a queued rid's tombstone stays until _admit pops its
+            # heap entry (it must not be re-admitted)
+
+    def _sweep_cancelled(self) -> None:
+        """Lock held, once per loop: expire deadlines, and finalize
+        cancelled rids that hold NO slot (queued ones — active ones
+        free their slot in ``_process``, the step boundary)."""
+        if self._deadlines:
+            now = self._clock()
+            for rid, dl in list(self._deadlines.items()):
+                if now >= dl and rid not in self._ended:
+                    self._cancelled.setdefault(rid, "deadline")
+        if not self._cancelled:
+            return
+        seated = set(r for r in self._slot_rid if r is not None)
+        for rid, reason in list(self._cancelled.items()):
+            if rid not in seated:
+                self._finalize(rid, reason)
+
     # -- admission -----------------------------------------------------------
-    def _admit(self, firsts: List[Tuple[int, Any]]) -> None:
+    # Two phases: PICK under the engine lock (queue pops + slot
+    # seating + gauges — everything submit()/cancel()/load() observe),
+    # then the prefill/inject PROGRAMS outside it — a first-use bucket
+    # compile takes seconds on real configs, and holding the lock
+    # through it would stall every submitter and the gateway's
+    # routing/scrape paths behind one admission.
+    def _pick_admissions(self) -> List[Tuple[int, int, Request,
+                                             Optional[KVHandoff]]]:
+        picks: List[Tuple[int, int, Request,
+                          Optional[KVHandoff]]] = []
         while self._queue:
             arrival, rid, req = self._queue[0]
+            if rid in self._ended:         # cancelled while queued
+                heapq.heappop(self._queue)
+                if not self.retain_results:
+                    self._ended.pop(rid, None)   # tombstone reaped
+                continue
             if arrival > self._step_idx:
                 break
             free = np.flatnonzero(~self._active)
@@ -251,11 +414,26 @@ class ServeEngine:
             heapq.heappop(self._queue)
             slot = int(free[0])
             self._m["wait"].observe(max(0, self._step_idx - arrival))
-            firsts.append((rid, self._prefill_into(slot, rid, req)))
+            self._seat(slot, rid, req)
+            picks.append((slot, rid, req,
+                          self._handoffs.pop(rid, None)))
         self._m["queue"].set(len(self._queue))
         self._m["slots"].set(int(self._active.sum()))
+        return picks
 
-    def _prefill_into(self, slot: int, rid: int, req: Request):
+    def _run_admissions(self, picks, firsts: List[Tuple[int, Any]]
+                        ) -> None:
+        """Run the admission programs for already-seated picks (engine
+        thread only — slot/cache state is loop-private)."""
+        for slot, rid, req, handoff in picks:
+            if handoff is not None:
+                firsts.append(
+                    (rid, self._inject_into(slot, handoff)))
+            else:
+                firsts.append(
+                    (rid, self._prefill_into(slot, req)))
+
+    def _prefill_into(self, slot: int, req: Request):
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         bucket = bucket_for(prompt.size, self.min_bucket, self.max_len)
         fn = self._prefills.get(bucket)
@@ -276,13 +454,36 @@ class ServeEngine:
                 np.int32(self.cfg.vocab_size if req.top_k is None
                          else req.top_k),
                 np.float32(1.0 if req.top_p is None else req.top_p))
+        return tok
+
+    def _inject_into(self, slot: int, h: KVHandoff):
+        """Admission program for a handed-off prefill (disaggregated
+        mode): one compiled inject program per block bucket writes the
+        KV block + per-slot vectors; the first token was already
+        sampled on the prefill worker and is returned as a HOST array
+        (``_process`` reads firsts uniformly)."""
+        bucket = int(h.k.shape[2])
+        fn = self._injects.get(bucket)
+        if fn is None:
+            fn = telemetry.watch(
+                jax.jit(partial(llama.inject_slot_kv, self.cfg,
+                                mesh=self.mesh), donate_argnums=(6,)),
+                f"serve_inject_b{bucket}", expected=1)
+            self._injects[bucket] = fn
+        with self._span_prefill(bucket=bucket, inject=True):
+            self._kv, self._sv = fn(
+                h.k, h.v, np.int32(h.true_len), np.int32(slot),
+                np.int32(h.token), np.asarray(h.rng, np.uint32),
+                self._kv, self._sv)
+        return np.asarray([h.token], np.int32)
+
+    def _seat(self, slot: int, rid: int, req: Request) -> None:
         self._active[slot] = True
         self._temps[slot] = req.temperature
         self._topks[slot] = (self.cfg.vocab_size if req.top_k is None
                              else req.top_k)
         self._topps[slot] = 1.0 if req.top_p is None else req.top_p
         self._slot_rid[slot] = rid
-        return tok
 
     # -- stepping ------------------------------------------------------------
     def _dispatch(self, firsts) -> _Dispatch:
@@ -311,70 +512,147 @@ class ServeEngine:
         if req.on_token is not None:
             req.on_token(rid, token)
         if len(self._results[rid]) >= req.max_new_tokens:
-            self._done[rid] = True
+            self._finalize(rid, "complete")
 
     def _process(self, disp: _Dispatch) -> None:
+        # the device sync happens OUTSIDE the lock — a submitter must
+        # never block behind a device readback
+        sampled = np.asarray(disp.sampled) if disp.slots else None
         now = time.perf_counter()
-        for rid, dev in disp.firsts:
-            self._emit(rid, int(np.asarray(dev)[0]), now)
-        if disp.slots:
-            sampled = np.asarray(disp.sampled)
-            for slot, rid in disp.slots:
-                if not self._done[rid]:
-                    self._emit(rid, int(sampled[slot]), now)
-        for slot, rid in enumerate(self._slot_rid):
-            if rid is not None and self._done[rid]:
-                self._active[slot] = False       # recycle at the next
-                self._slot_rid[slot] = None      # step boundary
-                self._last_tok.pop(rid, None)    # bounded: live rids only
-        self._m["slots"].set(int(self._active.sum()))
+        with self._lock:
+            for rid, dev in disp.firsts:
+                if rid not in self._cancelled:
+                    self._emit(rid, int(np.asarray(dev)[0]), now)
+            if disp.slots:
+                for slot, rid in disp.slots:
+                    # a pruned rid (non-retained, finalized) reads as
+                    # done — never emit for it
+                    if not self._done.get(rid, True) \
+                            and rid not in self._cancelled:
+                        self._emit(rid, int(sampled[slot]), now)
+            for slot, rid in enumerate(self._slot_rid):
+                if rid is None:
+                    continue
+                reason = self._cancelled.get(rid)
+                if reason is not None:
+                    self._finalize(rid, reason)
+                if self._done.get(rid, True):
+                    self._active[slot] = False   # recycle at the next
+                    self._slot_rid[slot] = None  # step boundary
+            self._m["slots"].set(int(self._active.sum()))
 
     # -- the serving loop ----------------------------------------------------
+    def _loop_iter(self, prev: Optional[_Dispatch]
+                   ) -> Optional[_Dispatch]:
+        """One engine step: sweep cancels/deadlines, admit, dispatch,
+        and (overlap permitting) process the PREVIOUS step's tokens
+        under this step's device time. Shared by :meth:`run` (batch
+        drain) and :meth:`run_forever` (the gateway's replica loop)."""
+        firsts: List[Tuple[int, Any]] = []
+        with self._lock:
+            self._sweep_cancelled()
+            picks = self._pick_admissions()
+        self._run_admissions(picks, firsts)
+        # any admission leaves its slot active, so firsts are
+        # always carried by a dispatch
+        out = (self._dispatch(firsts) if self._active.any()
+               else None)
+        if not self.overlap and out is not None:
+            self._process(out)
+            out = None
+        if prev is not None:
+            self._process(prev)
+        self._step_idx += 1
+        return out
+
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue: admit → dispatch → (overlapped) process,
         until every submitted request has completed. Returns
         {rid: generated tokens} (prompts not included, matching the
-        ``generate`` continuation)."""
+        ``generate`` continuation; a cancelled request's entry holds
+        whatever tokens it produced before its cancellation)."""
         prev: Optional[_Dispatch] = None
-        while self._queue or self._active.any() or prev is not None:
-            firsts: List[Tuple[int, Any]] = []
-            self._admit(firsts)
-            # any admission leaves its slot active, so firsts are
-            # always carried by a dispatch
-            out = (self._dispatch(firsts) if self._active.any()
-                   else None)
-            if not self.overlap and out is not None:
-                self._process(out)
-                out = None
-            if prev is not None:
-                self._process(prev)
-            prev = out
-            self._step_idx += 1
-            if (prev is None and not self._active.any()
-                    and self._queue):
-                # idle until the next scheduled arrival
-                self._step_idx = max(self._step_idx,
-                                     self._queue[0][0])
-        return {rid: np.asarray(toks, np.int32)
-                for rid, toks in self._results.items()}
+        while True:
+            with self._lock:
+                if not (self._queue or self._active.any()
+                        or prev is not None):
+                    break
+            prev = self._loop_iter(prev)
+            with self._lock:
+                if (prev is None and not self._active.any()
+                        and self._queue):
+                    # idle until the next scheduled arrival
+                    self._step_idx = max(self._step_idx,
+                                         self._queue[0][0])
+        with self._lock:
+            return {rid: np.asarray(toks, np.int32)
+                    for rid, toks in self._results.items()}
+
+    def run_forever(self, stop: threading.Event,
+                    idle_wait: float = 0.02) -> None:
+        """The replica loop: serve submissions as they arrive until
+        ``stop`` is set, then DRAIN — in-flight and queued requests
+        finish (or hit their deadlines) before the loop exits, so a
+        scale-down never drops accepted work. Idle waits block on the
+        submit/cancel condition, bounded by ``idle_wait`` so a stop
+        with no traffic is noticed promptly."""
+        prev: Optional[_Dispatch] = None
+        while True:
+            with self._cv:
+                work = (bool(self._queue) or self._active.any()
+                        or prev is not None)
+                if not work:
+                    if stop.is_set():
+                        break
+                    self._cv.wait(idle_wait)
+                    continue
+                if (prev is None and not self._active.any()
+                        and self._queue
+                        and self._queue[0][0] > self._step_idx):
+                    # future-only arrivals (seeded streams): jump the
+                    # step clock instead of spinning
+                    self._step_idx = self._queue[0][0]
+            prev = self._loop_iter(prev)
+
+    def wake(self) -> None:
+        """Nudge an idle :meth:`run_forever` (the gateway calls this
+        right after setting the stop event)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def load(self) -> Dict[str, int]:
+        """Routing snapshot: queued (submitted, not yet seated),
+        active slots, and the bank size — what the gateway's
+        least-loaded router and autoscaler read."""
+        with self._lock:
+            queued = sum(1 for _, rid, _r in self._queue
+                         if rid not in self._ended)
+            return {"queued": queued,
+                    "active": int(self._active.sum()),
+                    "slots": self.max_slots}
 
     # -- introspection -------------------------------------------------------
     @property
     def compile_count(self) -> int:
-        """Compiled programs this engine has built: one per prefill
-        bucket + the single decode program. The churn test gates this
-        at ``buckets + 1`` — requests entering/leaving must never
+        """Compiled programs this engine has built: one per admission
+        bucket (prefill or, in disaggregated mode, inject) + the
+        single decode program. The churn test gates this at
+        ``buckets + 1`` — requests entering/leaving must never
         retrace."""
         # deliberately NO fallback: if jax moves the private
         # _cache_size API this raises loudly — a silent
         # len(fns) stand-in would make the no-retrace gate
         # vacuously true exactly when a retrace bug could hide
-        fns = [self._decode] + list(self._prefills.values())
+        fns = ([self._decode] + list(self._prefills.values())
+               + list(self._injects.values()))
         return int(sum(f._cache_size() for f in fns))
 
     @property
     def n_buckets(self) -> int:
-        return len(self._prefills)
+        """Distinct admission buckets compiled so far — prefill
+        programs plus (disaggregated mode) inject programs; the
+        compile bound is ``n_buckets + 1`` either way."""
+        return len(self._prefills) + len(self._injects)
 
     def latency_stats(self) -> Dict[str, float]:
         """Per-token latency: p50/p99 over the gaps between a

@@ -1,0 +1,32 @@
+"""Multi-replica serving tier over ``ServeEngine`` (docs/serving.md
+§gateway): an HTTP front door with admission control and token
+streaming, a replica manager with least-loaded routing and
+deadline/cancel plumbing, a disaggregated prefill/decode mode with a
+framed-RPC KV handoff (``mxtpu.rpc`` — the kvstore wire layer), and a
+telemetry-driven autoscaler.
+
+    from mxtpu.serve.gateway import Gateway
+    gw = Gateway(lambda: ServeEngine(cfg, params, ...), n_replicas=2)
+    port = gw.start_http()
+    # POST /v1/generate streams tokens; GET /metrics is Prometheus
+
+Disaggregated (DistServe-style) topology:
+
+    from mxtpu.serve.gateway import DisaggBackend
+    gw = Gateway(backend=DisaggBackend(cfg, params, n_prefill=2,
+                                       n_decode=2, max_slots=8))
+
+The routing/streaming contract preserves the engine's bit-identity
+guarantee end to end: tokens through the gateway — replicated or
+disaggregated — equal per-request ``llama.generate``.
+"""
+from .autoscale import AutoscalePolicy, Autoscaler
+from .disagg import DisaggBackend, KVChannel, PrefillWorker
+from .frontdoor import GatewayClient
+from .gateway import Gateway, GatewayOverloaded, RequestHandle
+from .replica import EngineReplica, ReplicaSet, Ticket
+
+__all__ = ["Gateway", "GatewayOverloaded", "RequestHandle",
+           "GatewayClient", "EngineReplica", "ReplicaSet", "Ticket",
+           "DisaggBackend", "KVChannel", "PrefillWorker",
+           "AutoscalePolicy", "Autoscaler"]

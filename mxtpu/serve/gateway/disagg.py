@@ -1,0 +1,438 @@
+"""Disaggregated prefill/decode (DistServe, OSDI '24): prefill is
+compute-bound (one big batched matmul pass over the prompt), decode is
+memory-bound (weight+KV streaming per token) — colocating them makes
+each steal the other's resource. This module splits them into
+independent pools joined by a KV handoff:
+
+- :class:`PrefillWorker` — runs ``llama.prefill_detached`` (one
+  compiled program per prompt bucket), reads the per-request KV block
+  back to host, and ships it over the channel.
+- :class:`KVChannel` — the handoff wire: ``mxtpu.rpc`` framed
+  messages (same codec + HMAC + frame-size ceiling as the kvstore)
+  over a socketpair (same host) or TCP (``listen``/``connect`` — the
+  cross-host deployment, prefill pool on compute-heavy hosts, decode
+  pool on HBM-heavy ones).
+- :class:`DisaggBackend` — the Gateway-facing composition: routes
+  prompts to the least-queued prefill worker, a feeder thread receives
+  handoffs and seats them in the least-loaded decode replica via
+  ``ServeEngine.submit_prefilled`` (→ ``llama.inject_slot_kv``).
+
+Bit-identity: ``prefill_detached`` is the same forward graph, sampler
+and rng chain as ``prefill_slot``; the block crosses the wire as raw
+bytes; ``inject_slot_kv`` is the scatter ``prefill_slot`` would have
+done. So a disaggregated request's tokens are bit-identical to the
+colocated engine AND to per-request ``generate`` (tier-1-gated).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import socket
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ... import rpc, telemetry
+from ...base import env_str
+from ...models import llama
+from ..engine import KVHandoff, Request, ServeEngine, bucket_for
+from .replica import ReplicaSet, Ticket
+
+__all__ = ["KVChannel", "PrefillWorker", "DisaggBackend"]
+
+
+def _channel_secret() -> bytes:
+    return env_str(
+        "MXTPU_GATEWAY_SECRET", "",
+        "Shared secret for the gateway KV-handoff channel: every "
+        "handoff frame is HMAC-SHA256-authenticated when set (the "
+        "kvstore wire discipline). REQUIRED when prefill and decode "
+        "pools ride TCP across hosts.").encode()
+
+
+class KVChannel:
+    """One framed-RPC handoff pipe. Thread-safe on both sides (many
+    prefill workers share the send side; one feeder drains the
+    receive side)."""
+
+    def __init__(self, sock: socket.socket,
+                 secret: Optional[bytes] = None):
+        self._sock = sock
+        self._secret = (_channel_secret() if secret is None
+                        else secret)
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self._m_bytes = telemetry.histogram(
+            "gateway_kv_handoff_bytes",
+            "KV-handoff frame sizes on the prefill→decode channel",
+            buckets=telemetry.BYTES_BUCKETS)
+        self._m_count = telemetry.counter(
+            "gateway_kv_handoffs_total",
+            "KV blocks shipped prefill→decode")
+
+    @classmethod
+    def pair(cls, secret: Optional[bytes] = None
+             ) -> Tuple["KVChannel", "KVChannel"]:
+        """Same-process pair (the in-tree topology: pools as thread
+        groups, handoff still through the real wire codec)."""
+        a, b = socket.socketpair()
+        return cls(a, secret=secret), cls(b, secret=secret)
+
+    @classmethod
+    def listen(cls, host: str = "127.0.0.1", port: int = 0,
+               secret: Optional[bytes] = None
+               ) -> Tuple[socket.socket, int]:
+        """Decode-side accept socket for cross-host pools; returns
+        (listener, bound_port) — call :meth:`accept` next."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(8)
+        return srv, srv.getsockname()[1]
+
+    @classmethod
+    def accept(cls, listener: socket.socket,
+               secret: Optional[bytes] = None) -> "KVChannel":
+        conn, _ = listener.accept()
+        return cls(conn, secret=secret)
+
+    @classmethod
+    def connect(cls, host: str, port: int,
+                secret: Optional[bytes] = None,
+                timeout: float = 30.0) -> "KVChannel":
+        return cls(socket.create_connection((host, port),
+                                            timeout=timeout),
+                   secret=secret)
+
+    def send(self, msg: Any) -> None:
+        with self._send_lock:
+            n = rpc.send_msg(self._sock, msg, self._secret)
+        self._m_bytes.observe(n)
+        self._m_count.inc()
+
+    def recv(self) -> Any:
+        with self._recv_lock:
+            msg, _ = rpc.recv_msg(self._sock, self._secret)
+        return msg
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def handoff_to_wire(rid: int, h: KVHandoff) -> tuple:
+    return ("kv", int(rid), int(h.true_len), int(h.token),
+            np.asarray(h.k), np.asarray(h.v),
+            np.asarray(h.rng, np.uint32))
+
+
+def wire_to_handoff(msg: tuple) -> Tuple[int, KVHandoff]:
+    if not (isinstance(msg, tuple) and len(msg) == 7
+            and msg[0] == "kv"):
+        raise rpc.RPCProtocolError(
+            f"not a KV-handoff frame: {str(msg)[:80]}")
+    _, rid, true_len, token, k, v, rng = msg
+    return int(rid), KVHandoff(k=k, v=v, true_len=int(true_len),
+                               token=int(token), rng=rng)
+
+
+class PrefillWorker:
+    """One prefill compute thread: pops (rid, Request) jobs, runs the
+    bucketed ``prefill_detached`` program, host-gathers the block (the
+    sync IS this pool's job — decode never blocks on it) and ships it
+    over the channel."""
+
+    def __init__(self, cfg, params, channel: KVChannel, *,
+                 min_bucket: int, max_len: int, mesh=None,
+                 name: str = "p0"):
+        self.cfg = cfg
+        self.params = params
+        self.channel = channel
+        self.min_bucket = min_bucket
+        self.max_len = max_len
+        self.mesh = mesh
+        self.name = name
+        self._fns: Dict[int, Any] = {}
+        self._jobs: "queue.Queue[Any]" = queue.Queue()
+        self._span = telemetry.span_factory("gateway.prefill",
+                                            "gateway_prefill")
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mxtpu-gw-prefill-{name}")
+        self._thread.start()
+
+    def submit(self, rid: int, req: Request) -> None:
+        self._jobs.put((rid, req))
+
+    def pending(self) -> int:
+        return self._jobs.qsize()
+
+    def stop(self, join: bool = True, timeout: float = 60.0) -> None:
+        self._jobs.put(None)
+        if join:
+            self._thread.join(timeout)
+
+    @property
+    def compile_count(self) -> int:
+        return int(sum(f._cache_size() for f in self._fns.values()))
+
+    def _fn(self, bucket: int):
+        fn = self._fns.get(bucket)
+        if fn is None:
+            fn = telemetry.watch(
+                jax.jit(partial(llama.prefill_detached, self.cfg,
+                                mesh=self.mesh)),
+                f"gateway_prefill_b{bucket}", expected=1)
+            self._fns[bucket] = fn
+        return fn
+
+    def _loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            rid, req = job
+            try:
+                prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+                bucket = bucket_for(prompt.size, self.min_bucket,
+                                    self.max_len)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :prompt.size] = prompt
+                V = self.cfg.vocab_size
+                with self._span(bucket=bucket):
+                    tok, kb, vb, rng = self._fn(bucket)(
+                        self.params, padded, np.int32(prompt.size),
+                        jax.random.PRNGKey(req.seed),
+                        np.float32(req.temperature),
+                        np.int32(V if req.top_k is None
+                                 else req.top_k),
+                        np.float32(1.0 if req.top_p is None
+                                   else req.top_p))
+                h = KVHandoff(k=np.asarray(kb), v=np.asarray(vb),
+                              true_len=int(prompt.size),
+                              token=int(np.asarray(tok)[0]),
+                              rng=np.asarray(rng, np.uint32))
+                self.channel.send(handoff_to_wire(rid, h))
+            except (ConnectionError, OSError):
+                return          # channel gone: pool is shutting down
+            except Exception as e:
+                # a failed prefill (device error, bad state) must not
+                # kill the worker and strand every later request: the
+                # error frame lets the feeder finalize THIS rid and
+                # the loop keeps serving
+                telemetry.counter(
+                    "gateway_prefill_errors_total",
+                    "Prefill jobs that failed on a worker").inc()
+                telemetry.flight().record("gateway", "prefill_error",
+                                          rid=rid, worker=self.name,
+                                          error=repr(e)[:200])
+                try:
+                    self.channel.send(("kverr", int(rid),
+                                       repr(e)[:200]))
+                except (ConnectionError, OSError):
+                    return
+
+
+class DisaggBackend:
+    """Prefill pool + decode replicas + the feeder joining them — the
+    same routing surface ``ReplicaSet`` gives the Gateway. The
+    autoscaler's ``scale_to`` moves the DECODE pool (the memory-bound
+    side, where slots live); the prefill pool is sized at
+    construction."""
+
+    def __init__(self, cfg, params, *, n_prefill: int = 1,
+                 n_decode: int = 1, max_slots: int = 4,
+                 max_len: Optional[int] = None,
+                 min_bucket: Optional[int] = None, mesh=None,
+                 channel: Optional[Tuple[KVChannel, KVChannel]] = None,
+                 clock=None, started: bool = True):
+        max_len = int(max_len or cfg.max_seq_len)
+        min_bucket = int(min_bucket or 16)
+        tx, rx = channel if channel is not None else KVChannel.pair()
+        self._tx, self._rx = tx, rx
+        self.decode = ReplicaSet(
+            lambda: ServeEngine(cfg, params, max_slots=max_slots,
+                                max_len=max_len, min_bucket=min_bucket,
+                                mesh=mesh, clock=clock),
+            n_decode, started=started)
+        self.prefill: List[PrefillWorker] = [
+            PrefillWorker(cfg, params, tx, min_bucket=min_bucket,
+                          max_len=max_len, mesh=mesh, name=f"p{i}")
+            for i in range(max(1, n_prefill))]
+        import time as _time
+        self._clock = clock or _time.monotonic
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        # rid -> (request, ticket, submit time on self._clock)
+        self._pending: Dict[int, Tuple[Request, "_DisaggTicket",
+                                       float]] = {}
+        self._feeder = threading.Thread(target=self._feed, daemon=True,
+                                        name="mxtpu-gw-kv-feeder")
+        self._feeder.start()
+
+    # -- Gateway surface -----------------------------------------------------
+    def route(self, req: Request, handoff=None) -> "Ticket":
+        if handoff is not None:
+            return self.decode.route(req, handoff=handoff)
+        # validate NOW (the prefill thread can only log, not raise to
+        # the caller) — same checks ServeEngine.submit applies
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got "
+                f"{req.max_new_tokens}")
+        if prompt.size + req.max_new_tokens > self._max_len():
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_len")
+        if req.top_k is not None and req.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {req.top_k}")
+        if req.top_p is not None and not 0.0 < req.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got "
+                             f"{req.top_p}")
+        ticket = _DisaggTicket(self)
+        with self._lock:
+            rid = next(self._seq)
+            ticket.rid = rid
+            self._pending[rid] = (req, ticket, self._clock())
+        worker = min(self.prefill, key=lambda w: w.pending())
+        worker.submit(rid, req)
+        return ticket
+
+    def load_total(self) -> Dict[str, int]:
+        out = self.decode.load_total()
+        with self._lock:
+            out["queued"] += len(self._pending)
+        return out
+
+    def state(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            n_pending = len(self._pending)
+        return ([dict(name=w.name, role="prefill", alive=True,
+                      queued=w.pending(), active=0, slots=0)
+                 for w in self.prefill]
+                + [dict(r, role="decode")
+                   for r in self.decode.state()]
+                + [dict(name="handoff", role="channel", alive=True,
+                        queued=n_pending, active=0, slots=0)])
+
+    @property
+    def size(self) -> int:
+        return self.decode.size
+
+    def scale_to(self, n: int) -> int:
+        return self.decode.scale_to(n)
+
+    def start(self) -> None:
+        self.decode.start()
+
+    def close(self) -> None:
+        for w in self.prefill:
+            w.stop(join=True)
+        self._tx.close()
+        self._rx.close()
+        self._feeder.join(10.0)
+        self.decode.close()
+
+    # -- internals -----------------------------------------------------------
+    def _max_len(self) -> int:
+        return self.prefill[0].max_len
+
+    @staticmethod
+    def _count_cancel(reason: str) -> None:
+        telemetry.counter(
+            "serve_cancelled_total",
+            "Requests ended before completion, by reason",
+            reason=reason).inc()
+
+    def _feed(self) -> None:
+        while True:
+            try:
+                msg = self._rx.recv()
+            except (ConnectionError, OSError):
+                return                      # channel closed: shutdown
+            if (isinstance(msg, tuple) and len(msg) == 3
+                    and msg[0] == "kverr"):
+                rid, err = int(msg[1]), msg[2]
+                with self._lock:
+                    entry = self._pending.pop(rid, None)
+                if entry is not None and entry[0].on_done is not None:
+                    entry[0].on_done(rid, "error")
+                if entry is not None:
+                    self._count_cancel("error")
+                continue
+            try:
+                rid, handoff = wire_to_handoff(msg)
+            except rpc.RPCProtocolError as e:
+                # a foreign frame means the stream is desynced — stop
+                # feeding loudly rather than seat corrupt state
+                telemetry.flight().record("gateway", "kv_channel_error",
+                                          error=repr(e)[:200])
+                return
+            with self._lock:
+                entry = self._pending.pop(rid, None)
+                reason = (entry[1].cancelled_reason
+                          if entry is not None else None)
+            if entry is None:
+                continue                    # cancelled while prefilling
+            req, ticket, t_submit = entry
+            if reason is None and req.deadline_s is not None:
+                # the budget started at SUBMIT, not at seating: a
+                # request that burned it queued behind prefill expires
+                # here, and a survivor decodes on the REMAINDER
+                elapsed = self._clock() - t_submit
+                if elapsed >= req.deadline_s:
+                    reason = "deadline"
+                else:
+                    req.deadline_s = req.deadline_s - elapsed
+            if reason is not None:
+                self._count_cancel(reason)
+                if req.on_done is not None:
+                    req.on_done(rid, reason)
+                continue
+            seated = self.decode.route(req, handoff=handoff)
+            with self._lock:
+                ticket.seated = seated
+                reason = ticket.cancelled_reason
+            if reason is not None:          # cancel raced the seating
+                seated.cancel(reason)
+
+
+class _DisaggTicket:
+    """Cancellation handle across the two phases: before the handoff
+    lands the request only exists in ``_pending`` (cancel = drop +
+    fire on_done); after seating it is a decode-engine rid."""
+
+    def __init__(self, backend: DisaggBackend):
+        self._backend = backend
+        self.rid: Optional[int] = None
+        self.seated: Optional[Ticket] = None
+        self.cancelled_reason: Optional[str] = None
+
+    def cancel(self, reason: str = "cancel") -> bool:
+        with self._backend._lock:
+            if self.seated is not None:
+                seated = self.seated
+            else:
+                # pending (or mid-handoff): the feeder checks the
+                # reason under this same lock before/after seating
+                self.cancelled_reason = reason
+                entry = self._backend._pending.pop(self.rid, None)
+                seated = None
+        if seated is not None:
+            return seated.cancel(reason)
+        if entry is None:
+            return True          # feeder will honor cancelled_reason
+        req = entry[0]
+        self._backend._count_cancel(reason)
+        if req.on_done is not None:
+            req.on_done(self.rid, reason)
+        return True

@@ -1,0 +1,289 @@
+"""The gateway: admission control + routing + streaming handles over a
+replica backend (colocated ``ReplicaSet`` or disaggregated
+``DisaggBackend``), with the HTTP front door layered on top
+(``frontdoor.py``) and the autoscaler driving ``backend.scale_to``
+(``autoscale.py``). docs/serving.md has the topology diagram.
+
+Admission control is a bounded queue over the BACKEND's un-seated
+request count: once ``queued >= queue_max`` a new submission raises
+:class:`GatewayOverloaded` (the front door turns it into HTTP 429 +
+``Retry-After``) instead of growing an unbounded backlog whose every
+entry would miss its latency target anyway — load shedding at the
+door, the DistServe/Orca serving-tier discipline.
+
+Streaming: the engine's ``on_token`` callback feeds a per-request
+:class:`RequestHandle` queue and NEVER blocks — a slow HTTP consumer
+stalls its own socket writer thread, not the decode loop. The
+slow-client defense is the deadline: every request carries one
+(explicit, or ``MXTPU_GATEWAY_DEADLINE_S``), and an expired request
+frees its slot at the next step boundary.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ... import telemetry
+from ...base import env_float, env_int
+from ..engine import Request, ServeEngine
+from .replica import ReplicaSet, Ticket
+
+__all__ = ["Gateway", "GatewayOverloaded", "RequestHandle"]
+
+_DONE = object()     # stream sentinel
+
+
+class GatewayOverloaded(RuntimeError):
+    """Admission refused: the gateway queue is at its bound. Carries
+    the ``retry_after`` hint (seconds) the front door sends back."""
+
+    def __init__(self, depth: int, bound: int, retry_after: int):
+        super().__init__(
+            f"gateway queue full ({depth} >= {bound}); "
+            f"retry in ~{retry_after}s")
+        self.depth = depth
+        self.bound = bound
+        self.retry_after = retry_after
+
+
+class RequestHandle:
+    """One submitted request as the client sees it: a thread-safe
+    token stream plus the final reason (``complete`` / ``cancel`` /
+    ``deadline`` / ``disconnect``)."""
+
+    def __init__(self, gateway: "Gateway", submitted_at: float):
+        self._gw = gateway
+        self._submitted_at = submitted_at
+        self._first_at: Optional[float] = None
+        self._q: "queue.Queue[Any]" = queue.Queue()
+        self._done = threading.Event()
+        self.tokens: list = []
+        self.reason: Optional[str] = None
+        self.ticket: Optional[Ticket] = None
+
+    # engine-side callbacks (never block: queue puts + list appends)
+    def _on_token(self, rid: int, token: int) -> None:
+        if self._first_at is None:
+            self._first_at = time.perf_counter()
+            self._gw._m_ttft.observe(
+                1e3 * (self._first_at - self._submitted_at))
+        self.tokens.append(int(token))
+        self._q.put(int(token))
+
+    def _on_done(self, rid: int, reason: str) -> None:
+        self.reason = reason
+        self._done.set()
+        self._q.put(_DONE)
+
+    # client side
+    def stream(self, timeout: Optional[float] = 300.0):
+        """Yield tokens as they are produced; returns when the request
+        ends (``.reason`` is set by then)."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is _DONE:
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = 300.0) -> np.ndarray:
+        """Block until the request ends; returns the generated tokens
+        (partial if cancelled — check ``.reason``)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request did not finish in time")
+        return np.asarray(self.tokens, np.int32)
+
+    def cancel(self, reason: str = "cancel") -> bool:
+        if self.ticket is None:
+            return False
+        return self.ticket.cancel(reason)
+
+
+class Gateway:
+    """The serving front door over engine replicas.
+
+    ``backend`` is anything with ``route(req, handoff=None) -> Ticket``,
+    ``load_total()``, ``state()``, ``size``, ``scale_to(n)``,
+    ``start()`` and ``close()`` — ``ReplicaSet`` (colocated) or
+    ``DisaggBackend`` (split prefill/decode pools). Convenience: pass
+    ``engine_factory`` (+ ``n_replicas``) and the gateway builds the
+    colocated backend itself.
+
+    ``autoscale``: an :class:`~.autoscale.AutoscalePolicy` (or dict of
+    its fields) — enables the scaling loop against this backend.
+    """
+
+    def __init__(self, engine_factory:
+                 Optional[Callable[[], ServeEngine]] = None, *,
+                 backend=None, n_replicas: Optional[int] = None,
+                 queue_max: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 autoscale=None, started: bool = True,
+                 clock: Optional[Callable[[], float]] = None):
+        if (backend is None) == (engine_factory is None):
+            raise ValueError(
+                "pass exactly one of engine_factory / backend")
+        if backend is None:
+            backend = ReplicaSet(
+                engine_factory,
+                n_replicas if n_replicas is not None else env_int(
+                    "MXTPU_GATEWAY_REPLICAS", 1,
+                    "Engine replicas the gateway starts by default "
+                    "(scale_to / the autoscaler move it at runtime)."),
+                started=started)
+        self.backend = backend
+        self.queue_max = (queue_max if queue_max is not None
+                          else env_int(
+                              "MXTPU_GATEWAY_QUEUE_MAX", 64,
+                              "Gateway admission bound: requests "
+                              "queued (not yet seated in a slot) "
+                              "beyond this are refused with 429 + "
+                              "Retry-After."))
+        dflt = (default_deadline_s if default_deadline_s is not None
+                else env_float(
+                    "MXTPU_GATEWAY_DEADLINE_S", 0.0,
+                    "Default per-request deadline (seconds) the "
+                    "gateway applies when a request does not set one; "
+                    "0 disables."))
+        self.default_deadline_s = dflt if dflt and dflt > 0 else None
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._closed = False
+        self._m_requests: Dict[str, Any] = {}
+        self._m_depth = telemetry.gauge(
+            "gateway_queue_depth",
+            "Requests accepted by the gateway, not yet seated")
+        self._m_ttft = telemetry.histogram(
+            "gateway_ttft_ms",
+            "Time to first token, submission to first on_token")
+        self._http = None
+        self._scaler = None
+        self._scaler_stop: Optional[threading.Event] = None
+        if autoscale is not None:
+            from .autoscale import Autoscaler, AutoscalePolicy
+            policy = (autoscale if isinstance(autoscale, AutoscalePolicy)
+                      else AutoscalePolicy(**dict(autoscale)))
+            self._scaler = Autoscaler(self.backend, policy,
+                                      clock=self._clock)
+            self._scaler_stop = threading.Event()
+            threading.Thread(target=self._scaler.run_forever,
+                             args=(self._scaler_stop,), daemon=True,
+                             name="mxtpu-gw-autoscale").start()
+
+    def _count(self, code: str) -> None:
+        m = self._m_requests.get(code)
+        if m is None:
+            m = self._m_requests[code] = telemetry.counter(
+                "gateway_requests_total",
+                "Requests at the gateway front door, by outcome code",
+                code=code)
+        m.inc()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None, seed: int = 0,
+               deadline_s: Optional[float] = None) -> RequestHandle:
+        """Admission-check + route; returns the streaming handle.
+        Raises :class:`GatewayOverloaded` past the queue bound and
+        ``ValueError`` on invalid parameters (the front door maps
+        these to 429 / 400)."""
+        handle = RequestHandle(self, time.perf_counter())
+        req = Request(
+            prompt=prompt, max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature),
+            top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p),
+            seed=int(seed), on_token=handle._on_token,
+            on_done=handle._on_done,
+            deadline_s=(deadline_s if deadline_s is not None
+                        else self.default_deadline_s))
+        # ONE critical section from depth check to enqueue: every
+        # front-door thread races submit under overload, and an
+        # unsynchronized check-then-route would admit a whole
+        # thundering herd past the bound before any of them enqueued
+        with self._lock:
+            load = self.backend.load_total()
+            depth = load["queued"]
+            self._m_depth.set(depth)
+            if depth >= self.queue_max:
+                # Retry-After ≈ one queue-drain: pending seats over
+                # total slot throughput is unknowable without a
+                # latency model, so use pending/slots "generations"
+                retry = max(1, round(depth / max(1, load["slots"])))
+                self._count("429")
+                telemetry.flight().record("gateway", "shed",
+                                          depth=depth,
+                                          bound=self.queue_max)
+                raise GatewayOverloaded(depth, self.queue_max, retry)
+            try:
+                handle.ticket = self.backend.route(req)
+            except ValueError:
+                self._count("400")
+                raise
+        self._count("accepted")
+        return handle
+
+    def submit_dict(self, body: Dict[str, Any]) -> RequestHandle:
+        """The front door's JSON surface: validates types, forwards
+        known fields."""
+        if not isinstance(body, dict):
+            raise ValueError("body must be a JSON object")
+        if "prompt" not in body:
+            raise ValueError("missing 'prompt'")
+        prompt = body["prompt"]
+        if not isinstance(prompt, (list, tuple)) or not all(
+                isinstance(t, int) for t in prompt):
+            raise ValueError("'prompt' must be a list of ints")
+        return self.submit(
+            np.asarray(prompt, np.int32),
+            int(body.get("max_new_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=body.get("top_k"), top_p=body.get("top_p"),
+            seed=int(body.get("seed", 0)),
+            deadline_s=body.get("deadline_s"))
+
+    # -- front door / lifecycle ---------------------------------------------
+    def start_http(self, host: str = "127.0.0.1",
+                   port: Optional[int] = None) -> int:
+        """Bind + serve the HTTP front door on a daemon thread;
+        returns the bound port (pass 0 for an ephemeral one)."""
+        from .frontdoor import serve_http
+        if port is None:
+            port = env_int(
+                "MXTPU_GATEWAY_PORT", 9300,
+                "Default TCP port of the gateway HTTP front door.")
+        self._http, bound = serve_http(self, host, port)
+        return bound
+
+    def refresh_gauges(self) -> None:
+        """Point-in-time gauges are written on the submit path, which
+        goes quiet exactly when a drained backlog should read 0 — the
+        scrape endpoints re-read the source before exporting."""
+        self._m_depth.set(self.backend.load_total()["queued"])
+
+    def state(self) -> Dict[str, Any]:
+        """Live topology snapshot (GET /state; tools/diagnose.py)."""
+        load = self.backend.load_total()
+        self._m_depth.set(load["queued"])
+        return {"replicas": self.backend.state(),
+                "n_replicas": self.backend.size,
+                "queued": load["queued"], "active": load["active"],
+                "slots": load["slots"], "queue_max": self.queue_max,
+                "autoscaler": self._scaler.describe()
+                if self._scaler else None}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._scaler_stop is not None:
+            self._scaler_stop.set()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+        self.backend.close()

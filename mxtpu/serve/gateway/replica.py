@@ -1,0 +1,198 @@
+"""Engine replicas: N ``ServeEngine`` workers behind one router.
+
+A replica is a ``ServeEngine`` plus the thread running its
+``run_forever`` loop. The set routes each request to the least-loaded
+live replica (queued + active, normalized by slot count — occupancy
+routing, not round-robin: a replica stuck behind a long decode keeps
+its queue short instead of stacking latecomers). ``scale_to`` is the
+autoscaler's lever: scaling up starts fresh replicas from the factory;
+scaling down REMOVES a replica from routing and signals its stop event
+— the drained engine finishes every accepted request before its thread
+exits, so a scale-down never drops work.
+
+Tokens are a per-request property of the engine (each slot replays its
+own rng chain), so replication/routing cannot change output — the
+gateway-level bit-identity test in tests/test_gateway.py pins this
+across 2 replicas under a Poisson client stream.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ... import telemetry
+from ..engine import KVHandoff, Request, ServeEngine
+
+__all__ = ["EngineReplica", "ReplicaSet", "Ticket"]
+
+
+class Ticket:
+    """A routed request: where it landed and how to cancel it — the
+    opaque handle Gateway keeps per in-flight request."""
+
+    def __init__(self, replica: "EngineReplica", rid: int):
+        self.replica = replica
+        self.rid = rid
+
+    def cancel(self, reason: str = "cancel") -> bool:
+        return self.replica.cancel(self.rid, reason)
+
+
+class EngineReplica:
+    """One serving engine on its own daemon thread."""
+
+    def __init__(self, engine: ServeEngine, name: str = "r0"):
+        self.engine = engine
+        # a replica serves indefinitely: results flow through the
+        # on_token/on_done callbacks, so the engine must prune its
+        # per-request bookkeeping instead of retaining it forever
+        engine.retain_results = False
+        self.name = name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self.engine.run_forever, args=(self._stop,),
+            daemon=True, name=f"mxtpu-gw-{self.name}")
+        self._thread.start()
+
+    def submit(self, req: Request) -> int:
+        return self.engine.submit(req)
+
+    def submit_prefilled(self, handoff: KVHandoff, req: Request) -> int:
+        return self.engine.submit_prefilled(handoff, req)
+
+    def cancel(self, rid: int, reason: str) -> bool:
+        return self.engine.cancel(rid, reason)
+
+    def load(self) -> Dict[str, int]:
+        return self.engine.load()
+
+    def stop(self, join: bool = False, timeout: float = 60.0) -> None:
+        """Signal the loop to drain and exit; ``join=True`` waits."""
+        self._stop.set()
+        self.engine.wake()
+        if join and self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+
+class ReplicaSet:
+    """The colocated-serving backend: replicas + least-loaded routing
+    + the ``scale_to`` surface the autoscaler drives."""
+
+    def __init__(self, engine_factory: Callable[[], ServeEngine],
+                 n_replicas: int = 1, *, started: bool = True):
+        if n_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        self._factory = engine_factory
+        self._lock = threading.Lock()
+        self._closed = False
+        self._replicas: List[EngineReplica] = []
+        self._draining: List[EngineReplica] = []
+        self._seq = itertools.count()
+        self._started = started
+        self._m_replicas = telemetry.gauge(
+            "gateway_replicas", "Live engine replicas behind the "
+            "gateway router")
+        self.scale_to(n_replicas)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Start every replica loop (a set built with
+        ``started=False`` — tests that need a stalled backend — starts
+        here)."""
+        with self._lock:
+            self._started = True
+            for r in self._replicas:
+                r.start()
+
+    def close(self, timeout: float = 60.0) -> None:
+        with self._lock:
+            self._closed = True
+            reps = self._replicas + self._draining
+            self._replicas, self._draining = [], []
+        for r in reps:
+            r.stop()
+        for r in reps:
+            if r._thread is not None:
+                r._thread.join(timeout)
+        self._m_replicas.set(0)
+
+    # -- routing -----------------------------------------------------------
+    def route(self, req: Request,
+              handoff: Optional[KVHandoff] = None) -> Ticket:
+        """Submit to the least-loaded replica. Raises RuntimeError
+        after ``close()``. Pick + submit are ONE critical section:
+        concurrent routes must see each other's submissions (two
+        racing requests both reading queued=0 would pile onto the
+        same replica), and a route racing close() must never hand a
+        request to a replica nothing will serve."""
+        with self._lock:
+            if self._closed or not self._replicas:
+                raise RuntimeError("replica set is closed")
+            loads = [(r, r.load()) for r in self._replicas]
+            replica, _ = min(
+                loads, key=lambda rl: (rl[1]["queued"]
+                                       + rl[1]["active"])
+                / max(1, rl[1]["slots"]))
+            rid = (replica.submit(req) if handoff is None
+                   else replica.submit_prefilled(handoff, req))
+        return Ticket(replica, rid)
+
+    # -- autoscaler surface ------------------------------------------------
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def scale_to(self, n: int) -> int:
+        """Grow/shrink to ``n`` live replicas (floor 1). Shrinking
+        moves replicas to the draining list — out of routing
+        immediately, threads exit once their accepted work is done."""
+        n = max(1, int(n))
+        with self._lock:
+            if self._closed:
+                # a late autoscaler tick racing close() must never
+                # resurrect replicas nothing will ever stop
+                return 0
+            while len(self._replicas) < n:
+                r = EngineReplica(self._factory(),
+                                  name=f"r{next(self._seq)}")
+                if self._started:
+                    r.start()
+                self._replicas.append(r)
+            drained = []
+            while len(self._replicas) > n:
+                drained.append(self._replicas.pop())
+            self._draining.extend(drained)
+            self._draining = [d for d in self._draining if d.alive]
+            live = len(self._replicas)
+        for d in drained:
+            d.stop()
+        self._m_replicas.set(live)
+        return live
+
+    # -- introspection ------------------------------------------------------
+    def load_total(self) -> Dict[str, int]:
+        out = {"queued": 0, "active": 0, "slots": 0}
+        with self._lock:
+            reps = list(self._replicas)
+        for r in reps:
+            ld = r.load()
+            for k in out:
+                out[k] += ld[k]
+        return out
+
+    def state(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            reps = list(self._replicas)
+        return [dict(name=r.name, alive=r.alive, **r.load())
+                for r in reps]

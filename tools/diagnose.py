@@ -26,6 +26,47 @@ def report(flight_tail: int = 20):
     print(telemetry.flight().format_tail(flight_tail))
 
 
+def gateway_state(addr: str = ""):
+    """Live serving-gateway topology: replica/queue state scraped from
+    a running gateway's GET /state (``MXTPU_GATEWAY_ADDR=host:port``,
+    or pass the address). In-process gateway metrics already appear in
+    report()'s telemetry summary; this reaches a gateway in ANOTHER
+    process — the deployment case."""
+    addr = addr or os.environ.get("MXTPU_GATEWAY_ADDR", "")
+    if not addr:
+        return
+    host, _, port = addr.partition(":")
+    print(f"----------Gateway state ({addr})----------")
+    try:
+        from mxtpu.serve.gateway import GatewayClient
+        status, state = GatewayClient(host, int(port or 9300),
+                                      timeout=5.0).get_json("/state")
+    except Exception as e:
+        print(f"unreachable: {e!r}")
+        return
+    if status != 200:
+        print(f"HTTP {status}: {state}")
+        return
+    print(f"replicas={state['n_replicas']}  queued={state['queued']}"
+          f"/{state['queue_max']}  active={state['active']}"
+          f"/{state['slots']} slots")
+    for r in state.get("replicas", []):
+        role = r.get("role", "engine")
+        print(f"  {r['name']:<10} {role:<8} "
+              f"{'up' if r.get('alive') else 'DOWN':<5} "
+              f"queued={r['queued']} active={r['active']}"
+              f"/{r['slots']}")
+    scaler = state.get("autoscaler")
+    if scaler:
+        print(f"autoscaler: replicas={scaler['replicas']} in "
+              f"[{scaler['min']}, {scaler['max']}] "
+              f"target_p99={scaler['target_p99_ms']}ms "
+              f"last_p99={scaler['last_p99_ms']}")
+        for d in scaler.get("decisions", []):
+            print(f"  scale {d['direction']} {d['from']}->{d['to']} "
+                  f"pressure={d['pressure']} p99={d['p99_ms']}")
+
+
 def _tail_disk_dump(n: int = 20):
     """A crashed process can't answer report() — but its flight dump
     on disk can."""
@@ -58,6 +99,7 @@ def main():
     from mxtpu import native
     print("libmxtpu native:", native.available())
     report()
+    gateway_state()
     _tail_disk_dump()
 
 
