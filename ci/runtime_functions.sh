@@ -192,6 +192,19 @@ print(f"gateway_smoke: OK (4 streamed tokens bit-identical, "
 PYEOF
 }
 
+chaos_serve() {
+    # serving-tier fault tolerance (docs/robustness.md §serving): the
+    # seeded gateway-chaos suite — replica kill under a Poisson client
+    # stream, stall detection, deterministic re-dispatch bit-identity,
+    # severed/corrupted KV channel self-healing, prefill-worker
+    # respawn, circuit-breaker fallback — in a fresh pytest process,
+    # then tools/flakiness_checker.py x3 over the file to prove the
+    # chaos plans are deterministic (a flaky fault-tolerance test is
+    # worse than none — the PR 2 discipline, applied to serving).
+    python -m pytest tests/test_serve_chaos.py -x -q "$@"
+    python tools/flakiness_checker.py tests/test_serve_chaos.py -n 3
+}
+
 telemetry_smoke() {
     # the observability layer end to end in a fresh process on the
     # ENABLED-BY-DEFAULT path (docs/observability.md): metrics through
@@ -371,6 +384,7 @@ ci_all() {
     bench_smoke
     serve_smoke
     gateway_smoke
+    chaos_serve
     telemetry_smoke
     opperf_coverage
     bench_gate
@@ -387,6 +401,7 @@ ci_fast() {
     bench_smoke
     serve_smoke
     gateway_smoke
+    chaos_serve
     telemetry_smoke
 }
 

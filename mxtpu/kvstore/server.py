@@ -584,37 +584,34 @@ class ServerClient:
 
     # -- connection management -------------------------------------------
     def _connect(self, deadline: float, verify: bool = True) -> None:
-        delay = self._backoff_base
-        last = None
-        while True:
-            try:
-                sock = socket.create_connection(
-                    self._addr, timeout=max(0.1, self._request_timeout))
-                sock.settimeout(self._request_timeout)
-                if verify:
-                    # heartbeat: a freshly-accepted-but-hung or foreign
-                    # server must fail HERE (timeout/protocol error),
-                    # not after we replay a mutating request into it
-                    _send_msg(sock, ("ping",), self._secret)
-                    reply, _ = _recv_msg(sock, self._secret)
-                    if len(reply) < 2 or reply[1] != "mxtpu-ps":
-                        sock.close()
-                        raise PSProtocolError(
-                            f"service at {self._addr} is not an mxtpu "
-                            "kvstore server")
-                self._sock = sock
-                return
-            except (PSAuthError, PSProtocolError):
-                raise               # not transient — see class docs
-            except OSError as e:    # server may not be up yet
-                last = e
-                now = time.monotonic()
-                if now >= deadline:
-                    raise MXNetError(
-                        f"cannot reach kvstore server at {self._addr}: "
-                        f"{last}") from last
-                time.sleep(min(delay, max(0.01, deadline - now)))
-                delay = min(delay * 2, self._backoff_max)
+        def dial() -> socket.socket:
+            sock = socket.create_connection(
+                self._addr, timeout=max(0.1, self._request_timeout))
+            sock.settimeout(self._request_timeout)
+            return sock
+
+        def heartbeat(sock: socket.socket) -> None:
+            # a freshly-accepted-but-hung or foreign server must fail
+            # HERE (timeout/protocol error), not after we replay a
+            # mutating request into it
+            _send_msg(sock, ("ping",), self._secret)
+            reply, _ = _recv_msg(sock, self._secret)
+            if len(reply) < 2 or reply[1] != "mxtpu-ps":
+                raise PSProtocolError(
+                    f"service at {self._addr} is not an mxtpu "
+                    "kvstore server")
+
+        try:
+            self._sock = rpc.connect_with_backoff(
+                dial, deadline, backoff_base=self._backoff_base,
+                backoff_max=self._backoff_max,
+                verify=heartbeat if verify else None)
+        except (PSAuthError, PSProtocolError):
+            raise               # not transient — see class docs
+        except (ConnectionError, OSError) as e:
+            raise MXNetError(
+                f"cannot reach kvstore server at {self._addr}: "
+                f"{e}") from e
 
     def _drop_socket(self) -> None:
         if self._sock is not None:

@@ -35,6 +35,7 @@ import hashlib
 import hmac as _hmac
 import socket
 import struct
+import time
 from typing import Any, Callable, Optional, Tuple
 
 import numpy as onp
@@ -42,7 +43,8 @@ import numpy as onp
 from .base import env_int
 
 __all__ = ["RPCAuthError", "RPCProtocolError", "encode", "decode",
-           "send_msg", "recv_msg", "max_frame_bytes", "MAC_SIZE"]
+           "send_msg", "recv_msg", "max_frame_bytes", "MAC_SIZE",
+           "connect_with_backoff"]
 
 _LEN = struct.Struct("<Q")
 _I = struct.Struct("<q")
@@ -219,6 +221,56 @@ def decode(buf: bytes) -> Any:
     if pos != len(buf):
         raise RPCProtocolError("trailing bytes in rpc frame")
     return msg
+
+
+def connect_with_backoff(dial: Callable[[], socket.socket],
+                         deadline: float, *,
+                         backoff_base: float = 0.05,
+                         backoff_max: float = 2.0,
+                         verify: Optional[Callable[[socket.socket],
+                                                   None]] = None,
+                         sleep: Callable[[float], None] = time.sleep
+                         ) -> socket.socket:
+    """THE reconnect discipline every mxtpu socket client shares
+    (grown in ``kvstore/server.py``'s ``ServerClient`` for PR 2, lifted
+    here so the serving gateway's KV channel recovers the same way):
+    call ``dial()`` until it succeeds or ``deadline`` (a
+    ``time.monotonic()`` instant) passes, sleeping an exponentially
+    doubled backoff between attempts.
+
+    ``verify``, when given, runs a hello/heartbeat roundtrip on the
+    fresh socket — a hung, foreign, or wrong-secret peer must fail
+    HERE, before the caller replays any real traffic into it. Failures
+    split exactly like the PS client's:
+
+    - :class:`RPCAuthError` / :class:`RPCProtocolError` (from ``dial``
+      or ``verify``) propagate IMMEDIATELY — a secret mismatch or a
+      foreign service can only fail the same way forever, so retrying
+      it would turn a loud misconfiguration into a silent retry loop;
+    - ``OSError``/``ConnectionError`` are transient (peer restarting,
+      port not up yet) and are retried under the deadline.
+    """
+    delay = backoff_base
+    while True:
+        sock = None
+        try:
+            sock = dial()
+            if verify is not None:
+                verify(sock)
+            return sock
+        except (RPCAuthError, RPCProtocolError):
+            if sock is not None:
+                sock.close()
+            raise               # not transient — never retried
+        except OSError as e:
+            if sock is not None:
+                sock.close()
+            now = time.monotonic()
+            if now >= deadline:
+                raise ConnectionError(
+                    f"rpc peer unreachable before deadline: {e}") from e
+            sleep(min(delay, max(0.01, deadline - now)))
+            delay = min(delay * 2, backoff_max)
 
 
 def send_msg(sock: socket.socket, obj: Any, secret: bytes = b"") -> int:

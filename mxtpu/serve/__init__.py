@@ -21,10 +21,11 @@ replica routing, disaggregated prefill/decode, autoscaling — lives in
 ``mxtpu.serve.gateway`` (imported lazily: the engine alone must not
 pay for the gateway stack).
 """
-from .engine import KVHandoff, Request, ServeEngine, bucket_for
+from .engine import (KVHandoff, Request, ServeEngine, bucket_for,
+                     resume_key)
 
 __all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for",
-           "gateway"]
+           "resume_key", "gateway"]
 
 
 def __getattr__(name):

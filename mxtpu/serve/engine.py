@@ -52,7 +52,8 @@ import numpy as np
 from .. import telemetry
 from ..models import llama
 
-__all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for"]
+__all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for",
+           "resume_key"]
 
 # admission wait is measured in engine steps (arrival → slot grant)
 _WAIT_STEP_BUCKETS = (0.0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 1024)
@@ -126,7 +127,12 @@ class Request:
     request still running (or still queued) that many seconds after
     ``submit`` is cancelled at the next step boundary — the gateway's
     slow-client defense (a stalled consumer must not hold a slot
-    forever)."""
+    forever). ``rng``, when set, is an explicit (2,) uint32 chain
+    state used INSTEAD of ``PRNGKey(seed)`` — the gateway's
+    crash-recovery re-dispatch prefills ``prompt + already-streamed
+    tokens`` with the chain fast-forwarded past them
+    (:func:`resume_key`), so the resumed stream replays the exact
+    sampling chain a fault-free run would have used."""
     prompt: Any
     max_new_tokens: int
     temperature: float = 0.0
@@ -137,6 +143,40 @@ class Request:
     on_token: Optional[Callable[[int, int], None]] = None
     on_done: Optional[Callable[[int, str], None]] = None
     deadline_s: Optional[float] = None
+    rng: Optional[Any] = None
+
+
+def cancel_counter(reason: str):
+    """``serve_cancelled_total{reason}`` — the ONE definition of the
+    cancel counter; every serving layer (engine, gateway, disagg)
+    increments through here so the name/help/labels cannot fork."""
+    return telemetry.counter(
+        "serve_cancelled_total",
+        "Requests ended before completion, by reason",
+        reason=reason)
+
+
+@jax.jit
+def _fast_forward_chain(key, n):
+    """``n`` carry-half splits in ONE compiled dispatch (``n`` is a
+    traced operand, so one program covers every prefix length)."""
+    return jax.lax.fori_loop(
+        0, n, lambda _, k: jax.random.split(k)[0], key)
+
+
+def resume_key(seed: int, n_emitted: int) -> np.ndarray:
+    """The rng chain state of a request seeded ``seed`` after it has
+    emitted ``n_emitted`` tokens: every emission (the prefill's first
+    token and each decode step) consumes exactly one
+    ``jax.random.split``, keeping the carry half — so re-prefilling
+    ``prompt + emitted`` with this key makes token ``n_emitted + 1``
+    sample from the same subkey, on the same logits, as the fault-free
+    run (the engine's deterministic re-dispatch contract)."""
+    key = jax.random.PRNGKey(int(seed))
+    n = int(n_emitted)
+    if n > 0:
+        key = _fast_forward_chain(key, np.int32(n))
+    return np.asarray(key, np.uint32)
 
 
 @dataclass
@@ -333,10 +373,7 @@ class ServeEngine:
     def _cancel_counter(self, reason: str):
         m = self._m_cancel.get(reason)
         if m is None:
-            m = self._m_cancel[reason] = telemetry.counter(
-                "serve_cancelled_total",
-                "Requests ended before completion, by reason",
-                reason=reason)
+            m = self._m_cancel[reason] = cancel_counter(reason)
         return m
 
     def _finalize(self, rid: int, reason: str) -> None:
@@ -445,11 +482,17 @@ class ServeEngine:
             self._prefills[bucket] = fn
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :prompt.size] = prompt
+        # device-commit an explicit resume chain: a numpy key is a
+        # DIFFERENT jit-cache entry from the PRNGKey device array the
+        # normal path passes, so leaving it raw would recompile every
+        # prefill bucket once per crash re-dispatch
+        key = (jax.random.PRNGKey(req.seed) if req.rng is None
+               else jax.numpy.asarray(np.asarray(req.rng, np.uint32)))
         with self._span_prefill(bucket=bucket):
             tok, self._kv, self._sv = fn(
                 self.params, padded, np.int32(prompt.size),
                 np.int32(slot), self._kv, self._sv,
-                jax.random.PRNGKey(req.seed),
+                key,
                 np.float32(req.temperature),
                 np.int32(self.cfg.vocab_size if req.top_k is None
                          else req.top_k),

@@ -18,15 +18,26 @@ Disaggregated (DistServe-style) topology:
 
 The routing/streaming contract preserves the engine's bit-identity
 guarantee end to end: tokens through the gateway — replicated or
-disaggregated — equal per-request ``llama.generate``.
+disaggregated — equal per-request ``llama.generate``. That guarantee
+extends THROUGH failures (docs/robustness.md §serving): a supervisor
+restarts dead/stalled replicas and the gateway re-dispatches their
+in-flight requests past the already-streamed prefix with the rng
+chain fast-forwarded, so a crash-surviving stream is bit-identical to
+a fault-free one; the disagg KV channel reconnects + re-auths and a
+circuit breaker falls back to colocated prefill under sustained
+prefill failure.
 """
 from .autoscale import AutoscalePolicy, Autoscaler
-from .disagg import DisaggBackend, KVChannel, PrefillWorker
+from .disagg import (CircuitBreaker, DisaggBackend, KVChannel,
+                     PrefillWorker)
 from .frontdoor import GatewayClient
-from .gateway import Gateway, GatewayOverloaded, RequestHandle
-from .replica import EngineReplica, ReplicaSet, Ticket
+from .gateway import (Gateway, GatewayOverloaded, GatewayUnavailable,
+                      RequestHandle)
+from .replica import (EngineReplica, NoHealthyReplicas, ReplicaSet,
+                      ReplicaSupervisor, Ticket)
 
-__all__ = ["Gateway", "GatewayOverloaded", "RequestHandle",
-           "GatewayClient", "EngineReplica", "ReplicaSet", "Ticket",
-           "DisaggBackend", "KVChannel", "PrefillWorker",
-           "AutoscalePolicy", "Autoscaler"]
+__all__ = ["Gateway", "GatewayOverloaded", "GatewayUnavailable",
+           "RequestHandle", "GatewayClient", "EngineReplica",
+           "ReplicaSet", "ReplicaSupervisor", "NoHealthyReplicas",
+           "Ticket", "DisaggBackend", "KVChannel", "PrefillWorker",
+           "CircuitBreaker", "AutoscalePolicy", "Autoscaler"]

@@ -17,11 +17,27 @@ independent pools joined by a KV handoff:
   handoffs and seats them in the least-loaded decode replica via
   ``ServeEngine.submit_prefilled`` (→ ``llama.inject_slot_kv``).
 
+Self-healing (PR 7): TCP channels carry an HMAC hello handshake on
+every (re)connect and an ACK per handoff frame. A severed connection
+reconnects with exponential backoff (``rpc.connect_with_backoff`` —
+the kvstore client discipline, shared) and RESENDS the un-acked frame;
+the receive side re-accepts and the pending-table pop dedups a frame
+whose ack (not delivery) was lost. A wrong secret fails the handshake
+FAST (``RPCAuthError`` — never retried); a corrupted frame from an
+already-authenticated peer poisons only that connection (drop +
+re-accept + resend). A prefill worker that dies is respawned and its
+in-flight job resubmitted ONCE (the DataLoader dead-worker pattern);
+sustained prefill-path failure trips a circuit breaker that falls
+back to COLOCATED prefill on the decode replicas — ``prefill_slot``
+is the same graph/sampler/rng chain as detached+inject, so the
+fallback stays bit-identical while ``/healthz`` reports ``degraded``.
+
 Bit-identity: ``prefill_detached`` is the same forward graph, sampler
 and rng chain as ``prefill_slot``; the block crosses the wire as raw
 bytes; ``inject_slot_kv`` is the scatter ``prefill_slot`` would have
 done. So a disaggregated request's tokens are bit-identical to the
-colocated engine AND to per-request ``generate`` (tier-1-gated).
+colocated engine AND to per-request ``generate`` — with or without
+injected faults (tier-1-gated in tests/test_serve_chaos.py).
 """
 from __future__ import annotations
 
@@ -29,19 +45,26 @@ import itertools
 import queue
 import socket
 import threading
+import time
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ... import rpc, telemetry
-from ...base import env_str
+from ...base import env_float, env_int, env_str
 from ...models import llama
-from ..engine import KVHandoff, Request, ServeEngine, bucket_for
-from .replica import ReplicaSet, Ticket
+from ..engine import (KVHandoff, Request, ServeEngine, bucket_for,
+                      cancel_counter)
+from .replica import (EngineReplica, NoHealthyReplicas, ReplicaSet,
+                      Ticket)
 
-__all__ = ["KVChannel", "PrefillWorker", "DisaggBackend"]
+__all__ = ["KVChannel", "PrefillWorker", "DisaggBackend",
+           "CircuitBreaker"]
+
+_HELLO = ("kvhello", "mxtpu-kv")
+_HELLO_ACK = ("kvhello-ack", "mxtpu-kv")
 
 
 def _channel_secret() -> bytes:
@@ -56,15 +79,34 @@ def _channel_secret() -> bytes:
 class KVChannel:
     """One framed-RPC handoff pipe. Thread-safe on both sides (many
     prefill workers share the send side; one feeder drains the
-    receive side)."""
+    receive side).
+
+    TCP channels self-heal: pass ``redial`` (send side) or build the
+    receive side with ``accept(..., reaccept=True)`` and a severed
+    connection is re-dialed/re-accepted with backoff, re-authenticated
+    via the HMAC hello handshake, and the interrupted handoff resent
+    (:meth:`send_handoff` / :meth:`recv_handoff` — the ACKed, reliable
+    surface the disagg pools use; raw :meth:`send`/:meth:`recv` stay
+    as the unacknowledged primitive). Socketpair channels have no
+    redial path and keep the fail-fast behavior."""
 
     def __init__(self, sock: socket.socket,
-                 secret: Optional[bytes] = None):
-        self._sock = sock
+                 secret: Optional[bytes] = None, *,
+                 redial: Optional[Callable[[], socket.socket]] = None,
+                 listener: Optional[socket.socket] = None):
+        self._sock: Optional[socket.socket] = sock
         self._secret = (_channel_secret() if secret is None
                         else secret)
+        self._redial = redial
+        self._listener = listener
+        self._closing = False
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
+        self._retry_deadline_s = env_float(
+            "MXTPU_GATEWAY_KV_RETRY_DEADLINE_S", 30.0,
+            "Total reconnect+resend budget per KV-handoff frame "
+            "before the prefill worker gives the request up (size it "
+            "to cover a decode-host restart).")
         self._m_bytes = telemetry.histogram(
             "gateway_kv_handoff_bytes",
             "KV-handoff frame sizes on the prefill→decode channel",
@@ -72,12 +114,26 @@ class KVChannel:
         self._m_count = telemetry.counter(
             "gateway_kv_handoffs_total",
             "KV blocks shipped prefill→decode")
+        self._m_reconnects = telemetry.counter(
+            "gateway_kv_reconnects_total",
+            "KV-handoff channel reconnections (severed + re-dialed "
+            "or re-accepted, HMAC re-authenticated)")
+        self._m_resends = telemetry.counter(
+            "gateway_kv_resends_total",
+            "Handoff frames resent after a connection fault")
+        self._m_frame_errors = telemetry.counter(
+            "gateway_kv_frame_errors_total",
+            "Torn/corrupt/unauthenticated frames dropped by the "
+            "receive side (connection poisoned + re-accepted)")
 
+    # -- construction ---------------------------------------------------------
     @classmethod
     def pair(cls, secret: Optional[bytes] = None
              ) -> Tuple["KVChannel", "KVChannel"]:
         """Same-process pair (the in-tree topology: pools as thread
-        groups, handoff still through the real wire codec)."""
+        groups, handoff still through the real wire codec). No
+        reconnect path — a severed socketpair is a process bug, not a
+        network fault."""
         a, b = socket.socketpair()
         return cls(a, secret=secret), cls(b, secret=secret)
 
@@ -95,18 +151,71 @@ class KVChannel:
 
     @classmethod
     def accept(cls, listener: socket.socket,
-               secret: Optional[bytes] = None) -> "KVChannel":
+               secret: Optional[bytes] = None, *,
+               reaccept: bool = False) -> "KVChannel":
+        """Accept + HMAC-handshake one peer. ``reaccept=True`` keeps
+        the listener on the channel: a later severed/corrupted
+        connection is replaced by accepting (and re-authenticating)
+        the peer's redial instead of killing the feeder."""
+        sec = _channel_secret() if secret is None else secret
         conn, _ = listener.accept()
-        return cls(conn, secret=secret)
+        cls._handshake_server(conn, sec)
+        return cls(conn, secret=sec,
+                   listener=listener if reaccept else None)
 
     @classmethod
     def connect(cls, host: str, port: int,
                 secret: Optional[bytes] = None,
                 timeout: float = 30.0) -> "KVChannel":
-        return cls(socket.create_connection((host, port),
-                                            timeout=timeout),
-                   secret=secret)
+        """Dial + HMAC-handshake the decode side; the dialer is kept
+        as the channel's ``redial`` so ``send_handoff`` can reconnect
+        through a severed wire."""
+        sec = _channel_secret() if secret is None else secret
 
+        def dial() -> socket.socket:
+            s = socket.create_connection((host, port), timeout=timeout)
+            s.settimeout(timeout)
+            return s
+
+        sock = dial()
+        cls._handshake_client(sock, sec)
+        return cls(sock, secret=sec, redial=dial)
+
+    # -- the HMAC hello handshake --------------------------------------------
+    # Re-auth on every (re)connect, the PS client's heartbeat
+    # discipline: a wrong-secret or foreign peer fails HERE — as
+    # RPCAuthError/RPCProtocolError, which connect_with_backoff NEVER
+    # retries — instead of poisoning the first real handoff.
+    @staticmethod
+    def _handshake_client(sock: socket.socket, secret: bytes) -> None:
+        rpc.send_msg(sock, _HELLO, secret)
+        reply, _ = rpc.recv_msg(sock, secret)
+        if tuple(reply) != _HELLO_ACK:
+            raise rpc.RPCProtocolError(
+                f"peer is not an mxtpu KV-handoff endpoint: "
+                f"{str(reply)[:80]}")
+
+    @staticmethod
+    def _handshake_server(sock: socket.socket, secret: bytes) -> None:
+        try:
+            msg, _ = rpc.recv_msg(sock, secret)
+        except rpc.RPCAuthError:
+            # tell the dialer its auth was REJECTED before closing: the
+            # unauthenticated error frame fails the dialer's own MAC
+            # check, so IT raises RPCAuthError too — both sides fail
+            # fast instead of one retrying a misconfiguration forever
+            try:
+                rpc.send_msg(sock, ("kvhello-err", "auth"))
+            except OSError:
+                pass
+            raise
+        if tuple(msg) != _HELLO:
+            raise rpc.RPCProtocolError(
+                f"peer is not an mxtpu KV-handoff endpoint: "
+                f"{str(msg)[:80]}")
+        rpc.send_msg(sock, _HELLO_ACK, secret)
+
+    # -- raw (unacknowledged) primitives -------------------------------------
     def send(self, msg: Any) -> None:
         with self._send_lock:
             n = rpc.send_msg(self._sock, msg, self._secret)
@@ -118,12 +227,140 @@ class KVChannel:
             msg, _ = rpc.recv_msg(self._sock, self._secret)
         return msg
 
+    # -- reliable handoff surface --------------------------------------------
+    def _reconnect_locked(self,
+                          deadline: Optional[float] = None) -> None:
+        """Send-side: re-dial + re-auth under the send lock, bounded
+        by the CALLER's frame deadline when given — a fresh budget per
+        reconnect attempt would let one frame's give-up time reach a
+        multiple of the documented MXTPU_GATEWAY_KV_RETRY_DEADLINE_S."""
+        if self._redial is None:
+            raise ConnectionError(
+                "kv channel severed and not re-dialable")
+        if deadline is None:
+            deadline = time.monotonic() + self._retry_deadline_s
+        sock = rpc.connect_with_backoff(
+            self._redial, deadline,
+            verify=lambda s: self._handshake_client(s, self._secret))
+        self._sock = sock
+        self._m_reconnects.inc()
+        telemetry.flight().record("gateway", "kv_reconnect")
+
+    def _drop_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def send_handoff(self, msg: Any) -> None:
+        """Reliable send: frame + await the receiver's ack; on a
+        connection fault reconnect (backoff + HMAC re-auth) and
+        RESEND. The receiver's pending-table pop dedups the
+        delivered-but-unacked case. RPCAuthError propagates
+        immediately — an auth failure can only repeat.
+
+        The ack round-trip runs under the send lock, so concurrent
+        prefill workers serialize at one frame per seat round-trip.
+        That is deliberate: it keeps frame/ack pairing trivial under
+        reconnect, and prefill COMPUTE dominates the RTT at today's
+        scales. If the channel ever becomes the bottleneck, the acks
+        already carry the rid — correlate them through a dispatcher
+        to pipeline sends without changing the wire format."""
+        deadline = time.monotonic() + self._retry_deadline_s
+        sent_once = False
+        while True:
+            try:
+                with self._send_lock:
+                    if self._sock is None:
+                        self._reconnect_locked(deadline)
+                    n = rpc.send_msg(self._sock, msg, self._secret)
+                    if sent_once:
+                        self._m_resends.inc()
+                    reply, _ = rpc.recv_msg(self._sock, self._secret)
+                if not (isinstance(reply, tuple) and len(reply) == 2
+                        and reply[0] == "kvack"):
+                    raise rpc.RPCProtocolError(
+                        f"expected handoff ack, got {str(reply)[:80]}")
+                self._m_bytes.observe(n)
+                self._m_count.inc()
+                return
+            except rpc.RPCAuthError:
+                with self._send_lock:
+                    self._drop_locked()
+                raise               # secret mismatch: never retried
+            except (ConnectionError, OSError) as e:
+                with self._send_lock:
+                    self._drop_locked()
+                sent_once = True
+                if self._closing or self._redial is None \
+                        or time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"kv handoff not deliverable: {e}") from e
+                telemetry.flight().record("gateway", "kv_send_retry",
+                                          error=repr(e)[:120])
+
+    def recv_handoff(self) -> Any:
+        """Reliable receive: one verified frame, acked back to the
+        sender. A torn/corrupt/misauthenticated frame on a
+        re-acceptable channel poisons only the CONNECTION (drop +
+        re-accept + re-auth); the sender resends. On a channel without
+        a listener the error propagates (socketpair topology keeps
+        the old fail-fast contract). A wrong-secret peer fails the
+        re-accept handshake loudly — no retry loop."""
+        while True:
+            try:
+                with self._recv_lock:
+                    msg, _ = rpc.recv_msg(self._sock, self._secret)
+                if (isinstance(msg, tuple) and len(msg) >= 2
+                        and msg[0] in ("kv", "kverr")):
+                    with self._send_lock:
+                        rpc.send_msg(self._sock, ("kvack", msg[1]),
+                                     self._secret)
+                return msg
+            except (rpc.RPCAuthError, rpc.RPCProtocolError) as e:
+                # the peer AUTHENTICATED at accept time, so this is
+                # wire damage or desync, not misconfiguration:
+                # quarantine the connection, take the redial
+                if self._closing or self._listener is None:
+                    raise
+                self._m_frame_errors.inc()
+                telemetry.flight().record(
+                    "gateway", "kv_frame_error", error=repr(e)[:120])
+                self._reaccept()
+            except (ConnectionError, OSError):
+                if self._closing or self._listener is None:
+                    raise
+                self._reaccept()
+
+    def _reaccept(self) -> None:
+        with self._recv_lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+            conn, _ = self._listener.accept()
+            # re-auth: a wrong-secret redial fails HERE, fast
+            self._handshake_server(conn, self._secret)
+            self._sock = conn
+        self._m_reconnects.inc()
+        telemetry.flight().record("gateway", "kv_reaccept")
+
     def close(self) -> None:
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self._sock.close()
+        self._closing = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
 
 
 def handoff_to_wire(rid: int, h: KVHandoff) -> tuple:
@@ -142,15 +379,117 @@ def wire_to_handoff(msg: tuple) -> Tuple[int, KVHandoff]:
                                token=int(token), rng=rng)
 
 
+class CircuitBreaker:
+    """Consecutive-failure breaker over the prefill path. closed →
+    normal routing; ``threshold`` consecutive failures → OPEN
+    (colocated-prefill fallback, ``/healthz`` degrades); after
+    ``cooldown_s`` one probe request is let through (HALF-OPEN) —
+    its success closes the breaker, its failure re-opens the clock.
+    Thread-safe; every transition hits
+    ``gateway_breaker_transitions_total{to}`` and the flight ring."""
+
+    def __init__(self, threshold: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.threshold = (threshold if threshold is not None
+                          else env_int(
+                              "MXTPU_GATEWAY_BREAKER_THRESHOLD", 3,
+                              "Consecutive prefill-path failures "
+                              "(worker deaths, failed jobs, channel "
+                              "give-ups) that trip the disagg "
+                              "circuit breaker into colocated-"
+                              "prefill fallback."))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else env_float(
+                               "MXTPU_GATEWAY_BREAKER_COOLDOWN_S",
+                               30.0,
+                               "Seconds an OPEN disagg breaker waits "
+                               "before letting one half-open probe "
+                               "request test the prefill pool."))
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self.trips = 0
+        self._opened_at = 0.0
+        self._half_open_at = 0.0
+        self._m: Dict[str, Any] = {}
+
+    def _transition(self, to: str) -> None:
+        self._state = to
+        m = self._m.get(to)
+        if m is None:
+            m = self._m[to] = telemetry.counter(
+                "gateway_breaker_transitions_total",
+                "Disagg circuit-breaker state transitions", to=to)
+        m.inc()
+        telemetry.flight().record("gateway", "breaker", state=to,
+                                  failures=self._failures)
+
+    def allow(self) -> bool:
+        """True → use the prefill pool; False → colocated fallback.
+        An OPEN breaker past its cooldown grants exactly ONE half-open
+        probe per cooldown window."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            now = self._clock()
+            if self._state == "open" \
+                    and now - self._opened_at >= self.cooldown_s:
+                self._half_open_at = now
+                self._transition("half_open")
+                return True          # the one probe
+            if self._state == "half_open" \
+                    and now - self._half_open_at >= self.cooldown_s:
+                # the last probe never resolved (cancelled mid-
+                # prefill, client gone): re-grant rather than strand
+                # the breaker in half_open forever
+                self._half_open_at = now
+                return True
+            return False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == "half_open" \
+                    or (self._state == "closed"
+                        and self._failures >= self.threshold):
+                self._opened_at = self._clock()
+                self.trips += 1
+                self._transition("open")
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == "open":
+                # a straggler handoff submitted BEFORE the trip: its
+                # success says nothing about the pool now — hold open
+                # for the cooldown and let the half-open probe decide,
+                # else the breaker flaps on every in-flight leftover
+                return
+            self._failures = 0
+            if self._state != "closed":
+                self._transition("closed")
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self._state, "failures": self._failures,
+                    "trips": self.trips,
+                    "threshold": self.threshold}
+
+
 class PrefillWorker:
     """One prefill compute thread: pops (rid, Request) jobs, runs the
     bucketed ``prefill_detached`` program, host-gathers the block (the
     sync IS this pool's job — decode never blocks on it) and ships it
-    over the channel."""
+    over the channel. ``current()`` + ``drain()`` expose the in-flight
+    and queued jobs so the pool can respawn a dead worker and resubmit
+    its work (DataLoader's dead-worker pattern)."""
 
     def __init__(self, cfg, params, channel: KVChannel, *,
                  min_bucket: int, max_len: int, mesh=None,
-                 name: str = "p0"):
+                 name: str = "p0",
+                 on_fail: Optional[Callable[[int, str],
+                                            None]] = None):
         self.cfg = cfg
         self.params = params
         self.channel = channel
@@ -158,12 +497,17 @@ class PrefillWorker:
         self.max_len = max_len
         self.mesh = mesh
         self.name = name
+        self.on_fail = on_fail
+        self.stopping = False
+        self.failure: Optional[BaseException] = None
         self._fns: Dict[int, Any] = {}
         self._jobs: "queue.Queue[Any]" = queue.Queue()
+        self._cur_lock = threading.Lock()
+        self._current: Optional[Tuple[int, Request]] = None
         self._span = telemetry.span_factory("gateway.prefill",
                                             "gateway_prefill")
         self._thread = threading.Thread(
-            target=self._loop, daemon=True,
+            target=self._run, daemon=True,
             name=f"mxtpu-gw-prefill-{name}")
         self._thread.start()
 
@@ -173,7 +517,28 @@ class PrefillWorker:
     def pending(self) -> int:
         return self._jobs.qsize()
 
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def current(self) -> Optional[Tuple[int, Request]]:
+        with self._cur_lock:
+            return self._current
+
+    def drain(self) -> List[Tuple[int, Request]]:
+        """Pull every queued job off a (dead) worker for
+        resubmission."""
+        out: List[Tuple[int, Request]] = []
+        while True:
+            try:
+                job = self._jobs.get_nowait()
+            except queue.Empty:
+                return out
+            if job is not None:
+                out.append(job)
+
     def stop(self, join: bool = True, timeout: float = 60.0) -> None:
+        self.stopping = True
         self._jobs.put(None)
         if join:
             self._thread.join(timeout)
@@ -192,68 +557,121 @@ class PrefillWorker:
             self._fns[bucket] = fn
         return fn
 
+    def _run(self) -> None:
+        """Thread body: an exception escaping the job loop (a chaos
+        kill, an unexpected device fault) is a worker DEATH — recorded
+        so ``check_pools`` can tell a crash from a drain and respawn."""
+        try:
+            self._loop()
+        except BaseException as e:   # noqa: BLE001 — reported to pool
+            self.failure = e
+            telemetry.flight().record(
+                "gateway", "prefill_worker_died", worker=self.name,
+                error=repr(e)[:200])
+
     def _loop(self) -> None:
         while True:
             job = self._jobs.get()
             if job is None:
                 return
-            rid, req = job
+            with self._cur_lock:
+                self._current = job
+            # cleared only on normal return: an exception escaping
+            # _one kills the worker, and the job it died holding IS
+            # what check_pools must hand to the replacement
+            self._one(*job)
+            with self._cur_lock:
+                self._current = None
+
+    def _one(self, rid: int, req: Request) -> None:
+        try:
+            prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+            bucket = bucket_for(prompt.size, self.min_bucket,
+                                self.max_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :prompt.size] = prompt
+            V = self.cfg.vocab_size
+            # device-commit a resume chain (numpy key != PRNGKey
+            # device array in the jit cache — engine.py has the story)
+            key = (jax.random.PRNGKey(req.seed) if req.rng is None
+                   else jax.numpy.asarray(np.asarray(req.rng,
+                                                     np.uint32)))
+            with self._span(bucket=bucket):
+                tok, kb, vb, rng = self._fn(bucket)(
+                    self.params, padded, np.int32(prompt.size),
+                    key,
+                    np.float32(req.temperature),
+                    np.int32(V if req.top_k is None
+                             else req.top_k),
+                    np.float32(1.0 if req.top_p is None
+                               else req.top_p))
+            h = KVHandoff(k=np.asarray(kb), v=np.asarray(vb),
+                          true_len=int(prompt.size),
+                          token=int(np.asarray(tok)[0]),
+                          rng=np.asarray(rng, np.uint32))
+            self.channel.send_handoff(handoff_to_wire(rid, h))
+        except rpc.RPCAuthError:
+            raise                   # misconfiguration: die loudly
+        except (ConnectionError, OSError) as e:
+            if self.stopping:
+                raise               # pool shutdown: exit via _run
+            # the channel gave up on THIS frame (reconnect budget
+            # burned): fail the request, keep serving — the breaker
+            # decides whether the pool as a whole is still viable
+            telemetry.counter(
+                "gateway_prefill_errors_total",
+                "Prefill jobs that failed on a worker").inc()
+            telemetry.flight().record("gateway", "handoff_failed",
+                                      rid=rid, worker=self.name,
+                                      error=repr(e)[:200])
+            if self.on_fail is not None:
+                self.on_fail(rid, "error")
+        except Exception as e:
+            # a failed prefill (device error, bad state) must not
+            # kill the worker and strand every later request: the
+            # error frame lets the feeder finalize THIS rid and
+            # the loop keeps serving
+            telemetry.counter(
+                "gateway_prefill_errors_total",
+                "Prefill jobs that failed on a worker").inc()
+            telemetry.flight().record("gateway", "prefill_error",
+                                      rid=rid, worker=self.name,
+                                      error=repr(e)[:200])
             try:
-                prompt = np.asarray(req.prompt, np.int32).reshape(-1)
-                bucket = bucket_for(prompt.size, self.min_bucket,
-                                    self.max_len)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :prompt.size] = prompt
-                V = self.cfg.vocab_size
-                with self._span(bucket=bucket):
-                    tok, kb, vb, rng = self._fn(bucket)(
-                        self.params, padded, np.int32(prompt.size),
-                        jax.random.PRNGKey(req.seed),
-                        np.float32(req.temperature),
-                        np.int32(V if req.top_k is None
-                                 else req.top_k),
-                        np.float32(1.0 if req.top_p is None
-                                   else req.top_p))
-                h = KVHandoff(k=np.asarray(kb), v=np.asarray(vb),
-                              true_len=int(prompt.size),
-                              token=int(np.asarray(tok)[0]),
-                              rng=np.asarray(rng, np.uint32))
-                self.channel.send(handoff_to_wire(rid, h))
+                self.channel.send_handoff(("kverr", int(rid),
+                                           repr(e)[:200]))
             except (ConnectionError, OSError):
-                return          # channel gone: pool is shutting down
-            except Exception as e:
-                # a failed prefill (device error, bad state) must not
-                # kill the worker and strand every later request: the
-                # error frame lets the feeder finalize THIS rid and
-                # the loop keeps serving
-                telemetry.counter(
-                    "gateway_prefill_errors_total",
-                    "Prefill jobs that failed on a worker").inc()
-                telemetry.flight().record("gateway", "prefill_error",
-                                          rid=rid, worker=self.name,
-                                          error=repr(e)[:200])
-                try:
-                    self.channel.send(("kverr", int(rid),
-                                       repr(e)[:200]))
-                except (ConnectionError, OSError):
-                    return
+                # the error report itself is undeliverable: finalize
+                # locally so the request still ends exactly once —
+                # letting this escape would kill the worker with the
+                # POISONED job still marked in-flight, and check_pools
+                # would re-run the very prefill that just failed
+                if self.on_fail is not None:
+                    self.on_fail(rid, "error")
 
 
 class DisaggBackend:
     """Prefill pool + decode replicas + the feeder joining them — the
-    same routing surface ``ReplicaSet`` gives the Gateway. The
-    autoscaler's ``scale_to`` moves the DECODE pool (the memory-bound
-    side, where slots live); the prefill pool is sized at
-    construction."""
+    same routing surface ``ReplicaSet`` gives the Gateway (including
+    the supervisor's ``replicas``/``remove_replica``/``spawn_replica``,
+    which operate on the DECODE pool). The autoscaler's ``scale_to``
+    also moves the decode pool; the prefill pool is sized at
+    construction and kept at size by ``check_pools`` respawn."""
 
     def __init__(self, cfg, params, *, n_prefill: int = 1,
                  n_decode: int = 1, max_slots: int = 4,
                  max_len: Optional[int] = None,
                  min_bucket: Optional[int] = None, mesh=None,
                  channel: Optional[Tuple[KVChannel, KVChannel]] = None,
+                 breaker: Optional[CircuitBreaker] = None,
                  clock=None, started: bool = True):
         max_len = int(max_len or cfg.max_seq_len)
         min_bucket = int(min_bucket or 16)
+        self._cfg = cfg
+        self._params = params
+        self._mesh = mesh
+        self._min_bucket = min_bucket
+        self._mlen = max_len
         tx, rx = channel if channel is not None else KVChannel.pair()
         self._tx, self._rx = tx, rx
         self.decode = ReplicaSet(
@@ -261,20 +679,51 @@ class DisaggBackend:
                                 max_len=max_len, min_bucket=min_bucket,
                                 mesh=mesh, clock=clock),
             n_decode, started=started)
+        self._wseq = itertools.count()
         self.prefill: List[PrefillWorker] = [
-            PrefillWorker(cfg, params, tx, min_bucket=min_bucket,
-                          max_len=max_len, mesh=mesh, name=f"p{i}")
-            for i in range(max(1, n_prefill))]
-        import time as _time
-        self._clock = clock or _time.monotonic
+            self._new_worker() for _ in range(max(1, n_prefill))]
+        self.breaker = breaker if breaker is not None \
+            else CircuitBreaker(clock=clock)
+        self._m_wrestarts = telemetry.counter(
+            "gateway_prefill_restarts_total",
+            "Prefill workers respawned after dying")
+        self._m_fallback = telemetry.counter(
+            "gateway_breaker_fallback_total",
+            "Requests served via colocated prefill while the disagg "
+            "breaker was open")
+        self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self._seq = itertools.count()
         # rid -> (request, ticket, submit time on self._clock)
         self._pending: Dict[int, Tuple[Request, "_DisaggTicket",
                                        float]] = {}
+        # rids whose job was already resubmitted once after a worker
+        # death — a second death on the same rid fails the request
+        # (the DataLoader discipline: respawn + resubmit ONCE)
+        self._resubmitted: set = set()
         self._feeder = threading.Thread(target=self._feed, daemon=True,
                                         name="mxtpu-gw-kv-feeder")
         self._feeder.start()
+
+    def _new_worker(self) -> PrefillWorker:
+        return PrefillWorker(
+            self._cfg, self._params, self._tx,
+            min_bucket=self._min_bucket, max_len=self._mlen,
+            mesh=self._mesh, name=f"p{next(self._wseq)}",
+            on_fail=self._fail_pending)
+
+    def _fail_pending(self, rid: int, reason: str = "error") -> None:
+        """Finalize a pending request whose prefill/handoff failed
+        terminally (pops the pending table so load_total and the
+        admission bound stop charging for it)."""
+        self.breaker.record_failure()
+        with self._lock:
+            entry = self._pending.pop(rid, None)
+            self._resubmitted.discard(rid)
+        if entry is not None:
+            self._count_cancel(reason)
+            if entry[0].on_done is not None:
+                entry[0].on_done(rid, reason)
 
     # -- Gateway surface -----------------------------------------------------
     def route(self, req: Request, handoff=None) -> "Ticket":
@@ -298,13 +747,31 @@ class DisaggBackend:
         if req.top_p is not None and not 0.0 < req.top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got "
                              f"{req.top_p}")
+        if not self.breaker.allow():
+            # OPEN breaker: colocated fallback — the decode engine
+            # runs prefill_slot itself (same graph/sampler/rng chain,
+            # so tokens stay bit-identical); latency degrades, the
+            # request does not
+            self._m_fallback.inc()
+            return self.decode.route(req)
         ticket = _DisaggTicket(self)
+        # pick + submit under the SAME lock check_pools swaps workers
+        # under: an unsynchronized pick could land the job on a dead
+        # worker's queue just after its replacement drained it
         with self._lock:
-            rid = next(self._seq)
-            ticket.rid = rid
-            self._pending[rid] = (req, ticket, self._clock())
-        worker = min(self.prefill, key=lambda w: w.pending())
-        worker.submit(rid, req)
+            worker = min((w for w in self.prefill if w.alive),
+                         key=lambda w: w.pending(), default=None)
+            if worker is not None:
+                rid = next(self._seq)
+                ticket.rid = rid
+                self._pending[rid] = (req, ticket, self._clock())
+                worker.submit(rid, req)
+        if worker is None:
+            # whole pool down between check_pools passes: fall back
+            # rather than queue onto a corpse
+            self.breaker.record_failure()
+            self._m_fallback.inc()
+            return self.decode.route(req)
         return ticket
 
     def load_total(self) -> Dict[str, int]:
@@ -316,13 +783,70 @@ class DisaggBackend:
     def state(self) -> List[Dict[str, Any]]:
         with self._lock:
             n_pending = len(self._pending)
-        return ([dict(name=w.name, role="prefill", alive=True,
+        return ([dict(name=w.name, role="prefill", alive=w.alive,
+                      healthy=w.alive and not w.stopping,
+                      failed=w.failure is not None,
+                      error=(repr(w.failure)[:120] if w.failure
+                             else None),
                       queued=w.pending(), active=0, slots=0)
                  for w in self.prefill]
                 + [dict(r, role="decode")
                    for r in self.decode.state()]
                 + [dict(name="handoff", role="channel", alive=True,
-                        queued=n_pending, active=0, slots=0)])
+                        queued=n_pending, active=0, slots=0,
+                        breaker=self.breaker.describe())])
+
+    # -- supervisor surface (decode pool) ------------------------------------
+    def replicas(self) -> List[EngineReplica]:
+        return self.decode.replicas()
+
+    def remove_replica(self, replica: EngineReplica) -> bool:
+        return self.decode.remove_replica(replica)
+
+    def spawn_replica(self) -> Optional[EngineReplica]:
+        return self.decode.spawn_replica()
+
+    def breaker_state(self) -> Dict[str, Any]:
+        return self.breaker.describe()
+
+    def check_pools(self) -> int:
+        """The prefill half of supervision (called from the gateway's
+        maintenance loop): respawn dead workers and resubmit their
+        jobs ONCE — the in-flight job plus everything queued behind
+        it. A job whose SECOND worker also died is failed with reason
+        ``error`` (it is probably what killed them). Returns the
+        number of workers respawned."""
+        respawned = 0
+        for i in range(len(self.prefill)):
+            # capture + swap under the routing lock so a concurrent
+            # route() can never submit onto the corpse after we
+            # drained it
+            with self._lock:
+                w = self.prefill[i]
+                if w.alive or w.stopping:
+                    continue
+                jobs = ([w.current()]
+                        if w.current() is not None else []) \
+                    + w.drain()
+                fresh = self._new_worker()
+                self.prefill[i] = fresh
+            respawned += 1
+            self._m_wrestarts.inc()
+            self.breaker.record_failure()
+            telemetry.flight().record(
+                "gateway", "prefill_respawn", worker=w.name,
+                replacement=fresh.name, jobs=len(jobs),
+                error=(repr(w.failure)[:120] if w.failure else None))
+            for rid, req in jobs:
+                with self._lock:
+                    second = rid in self._resubmitted
+                    if not second:
+                        self._resubmitted.add(rid)
+                if second:
+                    self._fail_pending(rid, "error")
+                else:
+                    fresh.submit(rid, req)
+        return respawned
 
     @property
     def size(self) -> int:
@@ -344,26 +868,25 @@ class DisaggBackend:
 
     # -- internals -----------------------------------------------------------
     def _max_len(self) -> int:
-        return self.prefill[0].max_len
+        return self._mlen
 
     @staticmethod
     def _count_cancel(reason: str) -> None:
-        telemetry.counter(
-            "serve_cancelled_total",
-            "Requests ended before completion, by reason",
-            reason=reason).inc()
+        cancel_counter(reason).inc()
 
     def _feed(self) -> None:
         while True:
             try:
-                msg = self._rx.recv()
+                msg = self._rx.recv_handoff()
             except (ConnectionError, OSError):
                 return                      # channel closed: shutdown
             if (isinstance(msg, tuple) and len(msg) == 3
                     and msg[0] == "kverr"):
                 rid, err = int(msg[1]), msg[2]
+                self.breaker.record_failure()
                 with self._lock:
                     entry = self._pending.pop(rid, None)
+                    self._resubmitted.discard(rid)
                 if entry is not None and entry[0].on_done is not None:
                     entry[0].on_done(rid, "error")
                 if entry is not None:
@@ -379,11 +902,14 @@ class DisaggBackend:
                 return
             with self._lock:
                 entry = self._pending.pop(rid, None)
+                self._resubmitted.discard(rid)
                 reason = (entry[1].cancelled_reason
                           if entry is not None else None)
             if entry is None:
-                continue                    # cancelled while prefilling
+                continue    # cancelled while prefilling, or a resent
+                #             duplicate whose first copy already seated
             req, ticket, t_submit = entry
+            self.breaker.record_success()
             if reason is None and req.deadline_s is not None:
                 # the budget started at SUBMIT, not at seating: a
                 # request that burned it queued behind prefill expires
@@ -398,12 +924,45 @@ class DisaggBackend:
                 if req.on_done is not None:
                     req.on_done(rid, reason)
                 continue
-            seated = self.decode.route(req, handoff=handoff)
+            seated = self._seat_with_retry(req, handoff)
+            if seated is None:
+                self._count_cancel("error")
+                if req.on_done is not None:
+                    req.on_done(rid, "error")
+                continue
             with self._lock:
                 ticket.seated = seated
                 reason = ticket.cancelled_reason
             if reason is not None:          # cancel raced the seating
                 seated.cancel(reason)
+
+    def _seat_with_retry(self, req: Request, handoff: KVHandoff,
+                         budget_s: Optional[float] = None):
+        """Seat a handoff in the decode pool, riding out a transient
+        zero-healthy window (a decode replica down, its replacement
+        still in spawn backoff). The feeder thread must NEVER die on
+        this — a dead feeder acks nothing and wedges the whole
+        prefill pool. Returns None when seating is truly impossible
+        (budget burned, invalid state): the caller fails that one
+        request and keeps feeding. The budget runs on the backend's
+        injected clock (deterministic under a fake-clock test) and
+        defaults to the same per-frame retry knob as the channel."""
+        if budget_s is None:
+            budget_s = self._tx._retry_deadline_s
+        deadline = self._clock() + budget_s
+        while True:
+            try:
+                return self.decode.route(req, handoff=handoff)
+            except NoHealthyReplicas:
+                if self._clock() >= deadline:
+                    telemetry.flight().record(
+                        "gateway", "seat_failed", reason="no_replica")
+                    return None
+                time.sleep(0.05)
+            except (ValueError, RuntimeError) as e:
+                telemetry.flight().record(
+                    "gateway", "seat_failed", error=repr(e)[:120])
+                return None
 
 
 class _DisaggTicket:
@@ -416,6 +975,16 @@ class _DisaggTicket:
         self.rid: Optional[int] = None
         self.seated: Optional[Ticket] = None
         self.cancelled_reason: Optional[str] = None
+
+    def on_replica(self, replica: EngineReplica) -> bool:
+        """Supervision filter: this request rides ``replica`` once its
+        handoff has seated there (pre-seating it belongs to the
+        prefill pool, whose failures are handled by check_pools)."""
+        return self.seated is not None \
+            and self.seated.on_replica(replica)
+
+    def dead(self) -> bool:
+        return self.seated is not None and self.seated.dead()
 
     def cancel(self, reason: str = "cancel") -> bool:
         with self._backend._lock:

@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from ... import telemetry
-from .gateway import Gateway, GatewayOverloaded
+from .gateway import Gateway, GatewayOverloaded, GatewayUnavailable
 
 __all__ = ["serve_http", "GatewayClient"]
 
@@ -62,7 +62,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._json(200, {"ok": True})
+            # liveness plus the degradation story: load balancers key
+            # on "status" ("ok" / "degraded"), humans read the rest
+            self._json(200, self.gw.health())
         elif self.path == "/metrics":
             self.gw.refresh_gauges()
             body = telemetry.prometheus().encode()
@@ -91,6 +93,14 @@ class _Handler(BaseHTTPRequestHandler):
             handle = self.gw.submit_dict(body)
         except GatewayOverloaded as e:
             self._json(429, {"error": str(e),
+                             "retry_after_s": e.retry_after},
+                       {"Retry-After": str(e.retry_after)})
+            return
+        except GatewayUnavailable as e:
+            # zero healthy replicas: a DIFFERENT failure from
+            # overload — 503 says "the backend is down, retry later",
+            # with the same jittered Retry-After discipline
+            self._json(503, {"error": str(e),
                              "retry_after_s": e.retry_after},
                        {"Retry-After": str(e.retry_after)})
             return

@@ -2,14 +2,32 @@
 replica backend (colocated ``ReplicaSet`` or disaggregated
 ``DisaggBackend``), with the HTTP front door layered on top
 (``frontdoor.py``) and the autoscaler driving ``backend.scale_to``
-(``autoscale.py``). docs/serving.md has the topology diagram.
+(``autoscale.py``). docs/serving.md has the topology diagram;
+docs/robustness.md §serving covers the fault story below.
 
 Admission control is a bounded queue over the BACKEND's un-seated
 request count: once ``queued >= queue_max`` a new submission raises
 :class:`GatewayOverloaded` (the front door turns it into HTTP 429 +
 ``Retry-After``) instead of growing an unbounded backlog whose every
 entry would miss its latency target anyway — load shedding at the
-door, the DistServe/Orca serving-tier discipline.
+door, the DistServe/Orca serving-tier discipline. Past the SOFT bound
+(``MXTPU_GATEWAY_SHED_SOFT`` of the queue) admission turns
+deadline-aware: a request whose own budget is smaller than the
+estimated drain time is shed early (tier 1), because admitting it
+only burns a slot on an answer its client will never wait for. Every
+``Retry-After`` the door sends carries seeded JITTER — a synchronized
+herd shed by one burst must not re-arrive as one burst.
+
+Fault tolerance (PR 7): the gateway JOURNALS every accepted request
+(prompt, sampling params, seed, and — via the handle — the tokens
+already streamed). A :class:`~.replica.ReplicaSupervisor` health-checks
+the replicas; when one dies or stalls, its in-flight requests are
+re-dispatched to a healthy replica by re-prefilling ``prompt +
+streamed-prefix`` with the rng chain fast-forwarded
+(``serve.resume_key``), so the client's ndjson stream continues
+seamlessly and the full token list is BIT-IDENTICAL to a fault-free
+run. Zero healthy replicas raise :class:`GatewayUnavailable` → 503 +
+Retry-After at the door.
 
 Streaming: the engine's ``on_token`` callback feeds a per-request
 :class:`RequestHandle` queue and NEVER blocks — a slow HTTP consumer
@@ -21,39 +39,93 @@ frees its slot at the next step boundary.
 from __future__ import annotations
 
 import queue
+import random
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from ... import telemetry
 from ...base import env_float, env_int
-from ..engine import Request, ServeEngine
-from .replica import ReplicaSet, Ticket
+from ..engine import Request, ServeEngine, cancel_counter, resume_key
+from .replica import (NoHealthyReplicas, ReplicaSet, ReplicaSupervisor,
+                      Ticket)
 
-__all__ = ["Gateway", "GatewayOverloaded", "RequestHandle"]
+__all__ = ["Gateway", "GatewayOverloaded", "GatewayUnavailable",
+           "RequestHandle"]
 
 _DONE = object()     # stream sentinel
 
 
 class GatewayOverloaded(RuntimeError):
-    """Admission refused: the gateway queue is at its bound. Carries
-    the ``retry_after`` hint (seconds) the front door sends back."""
+    """Admission refused: the gateway queue is at its bound (or the
+    request's own deadline cannot survive the current backlog — the
+    tier-1 deadline-aware shed). Carries the ``retry_after`` hint
+    (seconds, jittered) the front door sends back."""
 
-    def __init__(self, depth: int, bound: int, retry_after: int):
+    def __init__(self, depth: int, bound: int, retry_after: int,
+                 tier: int = 2):
         super().__init__(
-            f"gateway queue full ({depth} >= {bound}); "
-            f"retry in ~{retry_after}s")
+            (f"gateway queue full ({depth} >= {bound}); "
+             f"retry in ~{retry_after}s") if tier == 2 else
+            (f"gateway backlog ({depth}/{bound}) outlives the "
+             f"request's deadline budget (tier-1 shed); "
+             f"retry in ~{retry_after}s"))
         self.depth = depth
         self.bound = bound
         self.retry_after = retry_after
+        self.tier = tier
+
+
+class GatewayUnavailable(RuntimeError):
+    """No healthy replica exists to carry the request (crash loop
+    past the restart budget, or the whole pool is down). The front
+    door maps this to 503 + ``Retry-After`` — distinct from overload:
+    the client should retry LATER, not slower."""
+
+    def __init__(self, msg: str, retry_after: int):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class _JournalEntry:
+    """Everything needed to re-dispatch one accepted request after a
+    replica failure: the immutable submission (prompt, sampling
+    params, seed, absolute deadline) plus live state (the handle —
+    whose ``tokens`` list IS the streamed-so-far record — the current
+    ticket, and an epoch guard that silences callbacks from a replica
+    the request has been moved off of)."""
+
+    __slots__ = ("gid", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "top_p", "seed", "deadline_abs", "handle",
+                 "ticket", "epoch", "done", "cancel_reason")
+
+    def __init__(self, gid: int, prompt: np.ndarray,
+                 max_new_tokens: int, temperature: float,
+                 top_k: Optional[int], top_p: Optional[float],
+                 seed: int, deadline_abs: Optional[float],
+                 handle: "RequestHandle"):
+        self.gid = gid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = seed
+        self.deadline_abs = deadline_abs
+        self.handle = handle
+        self.ticket: Optional[Ticket] = None
+        self.epoch = 0
+        self.done = False
+        self.cancel_reason: Optional[str] = None
 
 
 class RequestHandle:
     """One submitted request as the client sees it: a thread-safe
     token stream plus the final reason (``complete`` / ``cancel`` /
-    ``deadline`` / ``disconnect``)."""
+    ``deadline`` / ``disconnect`` / ``error``). Survives replica
+    failure transparently — re-dispatch feeds the same queue."""
 
     def __init__(self, gateway: "Gateway", submitted_at: float):
         self._gw = gateway
@@ -64,6 +136,7 @@ class RequestHandle:
         self.tokens: list = []
         self.reason: Optional[str] = None
         self.ticket: Optional[Ticket] = None
+        self._entry: Optional[_JournalEntry] = None
 
     # engine-side callbacks (never block: queue puts + list appends)
     def _on_token(self, rid: int, token: int) -> None:
@@ -97,6 +170,8 @@ class RequestHandle:
         return np.asarray(self.tokens, np.int32)
 
     def cancel(self, reason: str = "cancel") -> bool:
+        if self._entry is not None:
+            return self._gw._cancel_entry(self._entry, reason)
         if self.ticket is None:
             return False
         return self.ticket.cancel(reason)
@@ -107,13 +182,18 @@ class Gateway:
 
     ``backend`` is anything with ``route(req, handoff=None) -> Ticket``,
     ``load_total()``, ``state()``, ``size``, ``scale_to(n)``,
-    ``start()`` and ``close()`` — ``ReplicaSet`` (colocated) or
-    ``DisaggBackend`` (split prefill/decode pools). Convenience: pass
-    ``engine_factory`` (+ ``n_replicas``) and the gateway builds the
-    colocated backend itself.
+    ``replicas()``, ``remove_replica``/``spawn_replica``, ``start()``
+    and ``close()`` — ``ReplicaSet`` (colocated) or ``DisaggBackend``
+    (split prefill/decode pools). Convenience: pass ``engine_factory``
+    (+ ``n_replicas``) and the gateway builds the colocated backend
+    itself.
 
     ``autoscale``: an :class:`~.autoscale.AutoscalePolicy` (or dict of
     its fields) — enables the scaling loop against this backend.
+    ``supervise`` (default True): run the replica supervisor +
+    re-dispatch maintenance loop; ``supervisor_opts`` forwards kwargs
+    (heartbeat_s, stall_s, max_restarts, backoff) to
+    :class:`~.replica.ReplicaSupervisor`.
     """
 
     def __init__(self, engine_factory:
@@ -122,6 +202,9 @@ class Gateway:
                  queue_max: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
                  autoscale=None, started: bool = True,
+                 supervise: bool = True,
+                 supervisor_opts: Optional[Dict[str, Any]] = None,
+                 retry_jitter: Optional[float] = None,
                  clock: Optional[Callable[[], float]] = None):
         if (backend is None) == (engine_factory is None):
             raise ValueError(
@@ -149,8 +232,31 @@ class Gateway:
                     "gateway applies when a request does not set one; "
                     "0 disables."))
         self.default_deadline_s = dflt if dflt and dflt > 0 else None
+        self.shed_soft = env_float(
+            "MXTPU_GATEWAY_SHED_SOFT", 0.5,
+            "Soft-shed threshold as a fraction of the queue bound: "
+            "past it, requests whose own deadline is smaller than the "
+            "estimated drain time are refused early (tier-1 "
+            "deadline-aware shedding); 1.0 disables the tier.")
+        self.retry_jitter = (retry_jitter if retry_jitter is not None
+                             else env_float(
+                                 "MXTPU_GATEWAY_RETRY_JITTER", 0.5,
+                                 "Jitter fraction added to every "
+                                 "Retry-After the front door sends "
+                                 "(uniform in [0, max(1, f*base)]), "
+                                 "so a synchronized herd shed by one "
+                                 "429/503 burst does not re-arrive "
+                                 "as one burst. 0 disables."))
+        # seeded: jitter sequences are reproducible in tests while
+        # still de-synchronizing concurrent clients
+        self._retry_rng = random.Random(0xA5)
         self._clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()       # admission critical section
+        self._jlock = threading.Lock()      # journal (leaf lock: never
+        #                                     held while calling engines)
+        self._journal: Dict[int, _JournalEntry] = {}
+        self._gid = 0
+        self._repending: List[_JournalEntry] = []
         self._closed = False
         self._m_requests: Dict[str, Any] = {}
         self._m_depth = telemetry.gauge(
@@ -159,9 +265,22 @@ class Gateway:
         self._m_ttft = telemetry.histogram(
             "gateway_ttft_ms",
             "Time to first token, submission to first on_token")
+        self._m_redispatch = telemetry.counter(
+            "gateway_redispatch_total",
+            "In-flight requests moved off a failed replica and "
+            "resumed on a healthy one")
         self._http = None
         self._scaler = None
         self._scaler_stop: Optional[threading.Event] = None
+        self.supervisor: Optional[ReplicaSupervisor] = None
+        self._maint_stop: Optional[threading.Event] = None
+        if supervise and hasattr(self.backend, "replicas"):
+            self.supervisor = ReplicaSupervisor(
+                self.backend, on_down=self._on_replica_down,
+                **dict(supervisor_opts or {}))
+            self._maint_stop = threading.Event()
+            threading.Thread(target=self._maintain, daemon=True,
+                             name="mxtpu-gw-supervise").start()
         if autoscale is not None:
             from .autoscale import Autoscaler, AutoscalePolicy
             policy = (autoscale if isinstance(autoscale, AutoscalePolicy)
@@ -182,25 +301,30 @@ class Gateway:
                 code=code)
         m.inc()
 
+    def _retry_after(self, base: int) -> int:
+        """Jittered Retry-After: base plus a seeded uniform draw in
+        [0, max(1, jitter*base)] — neighbors shed together spread out
+        instead of re-arriving together."""
+        base = max(1, int(base))
+        if self.retry_jitter <= 0:
+            return base
+        span = max(1.0, self.retry_jitter * base)
+        return max(1, int(round(base + self._retry_rng.uniform(0,
+                                                               span))))
+
     # -- submission ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None, seed: int = 0,
                deadline_s: Optional[float] = None) -> RequestHandle:
-        """Admission-check + route; returns the streaming handle.
-        Raises :class:`GatewayOverloaded` past the queue bound and
-        ``ValueError`` on invalid parameters (the front door maps
-        these to 429 / 400)."""
+        """Admission-check + journal + route; returns the streaming
+        handle. Raises :class:`GatewayOverloaded` past the queue bound
+        (or the tier-1 deadline shed), :class:`GatewayUnavailable`
+        when no healthy replica exists, and ``ValueError`` on invalid
+        parameters (the front door maps these to 429 / 503 / 400)."""
         handle = RequestHandle(self, time.perf_counter())
-        req = Request(
-            prompt=prompt, max_new_tokens=int(max_new_tokens),
-            temperature=float(temperature),
-            top_k=None if top_k is None else int(top_k),
-            top_p=None if top_p is None else float(top_p),
-            seed=int(seed), on_token=handle._on_token,
-            on_done=handle._on_done,
-            deadline_s=(deadline_s if deadline_s is not None
-                        else self.default_deadline_s))
+        deadline = (deadline_s if deadline_s is not None
+                    else self.default_deadline_s)
         # ONE critical section from depth check to enqueue: every
         # front-door thread races submit under overload, and an
         # unsynchronized check-then-route would admit a whole
@@ -209,23 +333,111 @@ class Gateway:
             load = self.backend.load_total()
             depth = load["queued"]
             self._m_depth.set(depth)
+            drain = max(1, round(depth / max(1, load["slots"])))
             if depth >= self.queue_max:
-                # Retry-After ≈ one queue-drain: pending seats over
-                # total slot throughput is unknowable without a
-                # latency model, so use pending/slots "generations"
-                retry = max(1, round(depth / max(1, load["slots"])))
+                retry = self._retry_after(drain)
                 self._count("429")
                 telemetry.flight().record("gateway", "shed",
-                                          depth=depth,
+                                          depth=depth, tier=2,
                                           bound=self.queue_max)
-                raise GatewayOverloaded(depth, self.queue_max, retry)
+                raise GatewayOverloaded(depth, self.queue_max, retry,
+                                        tier=2)
+            if (self.shed_soft < 1.0
+                    and depth >= self.shed_soft * self.queue_max
+                    and deadline is not None and deadline < drain):
+                # tier 1: the backlog alone outlives this request's
+                # budget — admitting it burns a slot on an answer its
+                # client will never wait for
+                retry = self._retry_after(drain)
+                self._count("429")
+                telemetry.flight().record("gateway", "shed",
+                                          depth=depth, tier=1,
+                                          deadline_s=deadline)
+                raise GatewayOverloaded(depth, self.queue_max, retry,
+                                        tier=1)
+            with self._jlock:
+                self._gid += 1
+                entry = _JournalEntry(
+                    self._gid, np.asarray(prompt, np.int32).reshape(-1),
+                    int(max_new_tokens), float(temperature),
+                    None if top_k is None else int(top_k),
+                    None if top_p is None else float(top_p),
+                    int(seed),
+                    (None if deadline is None
+                     else self._clock() + float(deadline)),
+                    handle)
+                handle._entry = entry
+                self._journal[entry.gid] = entry
+            req = self._build_request(entry, deadline_s=deadline)
             try:
-                handle.ticket = self.backend.route(req)
+                ticket = self.backend.route(req)
+            except NoHealthyReplicas as e:
+                with self._jlock:
+                    self._journal.pop(entry.gid, None)
+                self._count("503")
+                telemetry.flight().record("gateway", "unavailable")
+                raise GatewayUnavailable(
+                    str(e), self._retry_after(1)) from e
             except ValueError:
+                with self._jlock:
+                    self._journal.pop(entry.gid, None)
                 self._count("400")
                 raise
+            except RuntimeError:
+                # e.g. "replica set is closed" racing shutdown — the
+                # journal entry must not outlive the refusal
+                with self._jlock:
+                    self._journal.pop(entry.gid, None)
+                self._count("error")
+                raise
+            with self._jlock:
+                entry.ticket = ticket
+            handle.ticket = ticket
         self._count("accepted")
         return handle
+
+    def _build_request(self, entry: _JournalEntry, *,
+                       deadline_s: Optional[float],
+                       emitted: Optional[List[int]] = None) -> Request:
+        """The dispatch (or RE-dispatch) of a journaled request.
+        ``emitted`` (re-dispatch only): tokens already streamed — the
+        prompt becomes ``prompt + emitted`` and the rng chain is
+        fast-forwarded past them (``resume_key``), so the resumed
+        stream is bit-identical to a fault-free run. Callbacks are
+        epoch-guarded: once the entry moves to a new replica, anything
+        a stale (stalled-then-unwedged) replica emits is dropped."""
+        epoch = entry.epoch
+        gw = self
+
+        def on_token(rid: int, token: int) -> None:
+            with gw._jlock:
+                if entry.epoch != epoch or entry.done:
+                    return
+                entry.handle._on_token(rid, token)
+
+        def on_done(rid: int, reason: str) -> None:
+            with gw._jlock:
+                if entry.epoch != epoch or entry.done:
+                    return
+                entry.done = True
+                gw._journal.pop(entry.gid, None)
+            entry.handle._on_done(rid, reason)
+
+        if emitted:
+            prompt = np.concatenate(
+                [entry.prompt, np.asarray(emitted, np.int32)])
+            rng = resume_key(entry.seed, len(emitted))
+            mnew = entry.max_new_tokens - len(emitted)
+        else:
+            prompt = entry.prompt
+            rng = None
+            mnew = entry.max_new_tokens
+        return Request(
+            prompt=prompt, max_new_tokens=mnew,
+            temperature=entry.temperature, top_k=entry.top_k,
+            top_p=entry.top_p, seed=entry.seed, rng=rng,
+            on_token=on_token, on_done=on_done,
+            deadline_s=deadline_s)
 
     def submit_dict(self, body: Dict[str, Any]) -> RequestHandle:
         """The front door's JSON surface: validates types, forwards
@@ -246,6 +458,161 @@ class Gateway:
             seed=int(body.get("seed", 0)),
             deadline_s=body.get("deadline_s"))
 
+    # -- fault recovery ------------------------------------------------------
+    def _cancel_entry(self, entry: _JournalEntry,
+                      reason: str) -> bool:
+        with self._jlock:
+            if entry.done:
+                return False
+            # recorded FIRST so a cancel racing a re-dispatch (old
+            # ticket already dead, new one not yet installed) is
+            # honored by _redispatch after it seats the request
+            entry.cancel_reason = reason
+            if entry in self._repending:
+                # between replicas: finalize directly, nothing holds
+                # a slot for it
+                self._repending.remove(entry)
+                entry.done = True
+                entry.epoch += 1
+                self._journal.pop(entry.gid, None)
+                ticket = None
+            else:
+                ticket = entry.ticket
+        if ticket is None:
+            cancel_counter(reason).inc()
+            entry.handle._on_done(-1, reason)
+            return True
+        return ticket.cancel(reason)
+
+    def _on_replica_down(self, replica, reason: str) -> None:
+        """Supervisor callback: collect the dead replica's journaled
+        in-flight requests and move them to a healthy replica."""
+        with self._jlock:
+            stranded = [e for e in self._journal.values()
+                        if not e.done and e.ticket is not None
+                        and e.ticket.on_replica(replica)]
+        if stranded:
+            telemetry.flight().record(
+                "gateway", "redispatch", replica=replica.name,
+                reason=reason, requests=len(stranded))
+        self._redispatch(stranded)
+
+    def _redispatch(self, entries: List[_JournalEntry]) -> None:
+        for entry in entries:
+            with self._jlock:
+                if entry.done:
+                    continue
+                cancelled = entry.cancel_reason
+                if cancelled is not None:
+                    # cancelled while its replica was dying: honor
+                    # the cancel instead of resuming dead work
+                    entry.done = True
+                    self._journal.pop(entry.gid, None)
+                else:
+                    # bump FIRST: from here, nothing a stale replica
+                    # emits can reach the handle, so the
+                    # streamed-prefix snapshot below is final
+                    entry.epoch += 1
+                    emitted = list(entry.handle.tokens)
+                    deadline_abs = entry.deadline_abs
+            if cancelled is not None:
+                cancel_counter(cancelled).inc()
+                entry.handle._on_done(-1, cancelled)
+                continue
+            remaining = entry.max_new_tokens - len(emitted)
+            if remaining <= 0:
+                # the client already has every token; only the final
+                # on_done was lost with the replica
+                with self._jlock:
+                    if entry.done:
+                        continue
+                    entry.done = True
+                    self._journal.pop(entry.gid, None)
+                entry.handle._on_done(-1, "complete")
+                continue
+            deadline_s = None
+            if deadline_abs is not None:
+                deadline_s = deadline_abs - self._clock()
+                if deadline_s <= 0:
+                    with self._jlock:
+                        if entry.done:
+                            continue
+                        entry.done = True
+                        self._journal.pop(entry.gid, None)
+                    cancel_counter("deadline").inc()
+                    entry.handle._on_done(-1, "deadline")
+                    continue
+            req = self._build_request(entry, deadline_s=deadline_s,
+                                      emitted=emitted)
+            try:
+                ticket = self.backend.route(req)
+            except NoHealthyReplicas:
+                sup = self.supervisor
+                if sup is None or sup.exhausted:
+                    # no replacement is ever coming: fail loudly
+                    # instead of parking the client forever
+                    with self._jlock:
+                        if entry.done:
+                            continue
+                        entry.done = True
+                        self._journal.pop(entry.gid, None)
+                    cancel_counter("error").inc()
+                    entry.handle._on_done(-1, "error")
+                    continue
+                # replacement still in backoff: park it; the
+                # maintenance loop retries after every spawn
+                with self._jlock:
+                    if not entry.done \
+                            and entry not in self._repending:
+                        self._repending.append(entry)
+                continue
+            except (ValueError, RuntimeError):
+                with self._jlock:
+                    if entry.done:
+                        continue
+                    entry.done = True
+                    self._journal.pop(entry.gid, None)
+                entry.handle._on_done(-1, "error")
+                continue
+            with self._jlock:
+                entry.ticket = ticket
+                cancelled = entry.cancel_reason
+            entry.handle.ticket = ticket
+            self._m_redispatch.inc()
+            if cancelled is not None:
+                # a cancel landed while we were routing: it targeted
+                # the dead ticket, so deliver it to the live one
+                ticket.cancel(cancelled)
+
+    def _maintain(self) -> None:
+        """The supervision heartbeat: health-check replicas, respawn
+        per policy, flush parked re-dispatches, and let a disagg
+        backend check its prefill pool/channel."""
+        stop = self._maint_stop
+        sup = self.supervisor
+        while not stop.wait(sup.heartbeat_s):
+            try:
+                sup.check()
+                check_pools = getattr(self.backend, "check_pools",
+                                      None)
+                if check_pools is not None:
+                    check_pools()
+                with self._jlock:
+                    parked = [e for e in self._repending
+                              if not e.done]
+                    self._repending = []
+                    # sweep for deaths that raced ticket
+                    # registration: any journaled entry still
+                    # pointing at a FAILED replica gets moved too
+                    parked += [e for e in self._journal.values()
+                               if not e.done and e not in parked
+                               and e.ticket is not None
+                               and e.ticket.dead()]
+                if parked:
+                    self._redispatch(parked)
+            except Exception:
+                telemetry.flight().record("gateway", "maintain_error")
+
     # -- front door / lifecycle ---------------------------------------------
     def start_http(self, host: str = "127.0.0.1",
                    port: Optional[int] = None) -> int:
@@ -265,14 +632,62 @@ class Gateway:
         scrape endpoints re-read the source before exporting."""
         self._m_depth.set(self.backend.load_total()["queued"])
 
+    def _breaker_snapshot(self) -> Optional[Dict[str, Any]]:
+        breaker_state = getattr(self.backend, "breaker_state", None)
+        return breaker_state() if breaker_state is not None else None
+
+    def health(self) -> Dict[str, Any]:
+        """GET /healthz body: liveness plus the DEGRADATION story — the
+        current shed tier, breaker state (disagg), restart budget —
+        so a load balancer (or an operator) sees 'alive but degraded'
+        instead of a binary."""
+        return self._health(self.backend.load_total(),
+                            self._breaker_snapshot(),
+                            self.supervisor.describe()
+                            if self.supervisor else None)
+
+    def _health(self, load: Dict[str, int],
+                breaker: Optional[Dict[str, Any]],
+                sup: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        depth = load["queued"]
+        tier = 0
+        if depth >= self.queue_max:
+            tier = 2
+        elif self.shed_soft < 1.0 \
+                and depth >= self.shed_soft * self.queue_max:
+            tier = 1
+        has_replicas = hasattr(self.backend, "replicas")
+        replicas = self.backend.replicas() if has_replicas else []
+        healthy = sum(1 for r in replicas if r.healthy)
+        degraded = (tier > 0
+                    or (has_replicas and healthy == 0)
+                    or (breaker is not None
+                        and breaker.get("state") != "closed")
+                    or bool(sup and sup["pending_spawns"]))
+        return {"ok": True,
+                "status": "degraded" if degraded else "ok",
+                "tier": tier, "queued": depth,
+                "queue_max": self.queue_max,
+                "healthy_replicas": healthy,
+                "breaker": breaker, "supervisor": sup}
+
     def state(self) -> Dict[str, Any]:
-        """Live topology snapshot (GET /state; tools/diagnose.py)."""
+        """Live topology snapshot (GET /state; tools/diagnose.py).
+        Load/breaker/supervisor are snapshotted ONCE and shared with
+        the embedded health block — a scrape must not double the lock
+        traffic on the serving hot structures."""
         load = self.backend.load_total()
         self._m_depth.set(load["queued"])
+        breaker = self._breaker_snapshot()
+        sup = (self.supervisor.describe()
+               if self.supervisor else None)
         return {"replicas": self.backend.state(),
                 "n_replicas": self.backend.size,
                 "queued": load["queued"], "active": load["active"],
                 "slots": load["slots"], "queue_max": self.queue_max,
+                "health": self._health(load, breaker, sup),
+                "supervisor": sup,
+                "breaker": breaker,
                 "autoscaler": self._scaler.describe()
                 if self._scaler else None}
 
@@ -281,6 +696,8 @@ class Gateway:
             if self._closed:
                 return
             self._closed = True
+        if self._maint_stop is not None:
+            self._maint_stop.set()
         if self._scaler_stop is not None:
             self._scaler_stop.set()
         if self._http is not None:

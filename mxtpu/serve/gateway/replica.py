@@ -1,8 +1,10 @@
-"""Engine replicas: N ``ServeEngine`` workers behind one router.
+"""Engine replicas: N ``ServeEngine`` workers behind one router, plus
+the supervision layer that keeps the set serving through replica
+failure.
 
 A replica is a ``ServeEngine`` plus the thread running its
 ``run_forever`` loop. The set routes each request to the least-loaded
-live replica (queued + active, normalized by slot count — occupancy
+HEALTHY replica (queued + active, normalized by slot count — occupancy
 routing, not round-robin: a replica stuck behind a long decode keeps
 its queue short instead of stacking latecomers). ``scale_to`` is the
 autoscaler's lever: scaling up starts fresh replicas from the factory;
@@ -10,21 +12,51 @@ scaling down REMOVES a replica from routing and signals its stop event
 — the drained engine finishes every accepted request before its thread
 exits, so a scale-down never drops work.
 
+Failure model (the PR 7 robustness layer):
+
+- a replica thread that DIES (an exception escaping the engine loop —
+  a device error mid-decode, a chaos-injected raise) records its
+  exception on the replica and flips :attr:`EngineReplica.failed`;
+- a replica that STALLS (thread alive, work pending, but the engine's
+  step counter stops advancing) is detected by the
+  :class:`ReplicaSupervisor`'s step-progress heartbeat;
+- either way the supervisor pulls the replica out of routing, spins up
+  a replacement (bounded restarts + exponential backoff, every event
+  in ``gateway_replica_restarts_total{reason}`` and the flight
+  recorder), and hands the dead replica's in-flight requests back to
+  the gateway for deterministic re-dispatch (``gateway.py``).
+
+``route`` with zero healthy replicas raises
+:class:`NoHealthyReplicas` — a DISTINCT error the front door turns
+into 503 + ``Retry-After`` (shed loudly, never hang a client on a
+backend nothing will serve).
+
 Tokens are a per-request property of the engine (each slot replays its
-own rng chain), so replication/routing cannot change output — the
-gateway-level bit-identity test in tests/test_gateway.py pins this
-across 2 replicas under a Poisson client stream.
+own rng chain), so replication/routing/restart cannot change output —
+the chaos tests in tests/test_serve_chaos.py pin bit-identity through
+an injected replica kill under a Poisson client stream.
 """
 from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ... import telemetry
+from ...base import env_float, env_int
 from ..engine import KVHandoff, Request, ServeEngine
 
-__all__ = ["EngineReplica", "ReplicaSet", "Ticket"]
+__all__ = ["EngineReplica", "ReplicaSet", "ReplicaSupervisor",
+           "Ticket", "NoHealthyReplicas"]
+
+
+class NoHealthyReplicas(RuntimeError):
+    """``route`` found no live replica to carry the request (all dead
+    or removed, restart budget exhausted, or the set is empty). The
+    front door maps this to 503 + ``Retry-After`` — distinct from
+    queue overload (429) and from a closed set (plain RuntimeError):
+    the client should retry later, not slower."""
 
 
 class Ticket:
@@ -38,6 +70,18 @@ class Ticket:
     def cancel(self, reason: str = "cancel") -> bool:
         return self.replica.cancel(self.rid, reason)
 
+    def on_replica(self, replica: "EngineReplica") -> bool:
+        """True when this request's fate is tied to ``replica`` — the
+        supervisor's re-dispatch filter."""
+        return self.replica is replica
+
+    def dead(self) -> bool:
+        """The carrying replica FAILED (crash/stall takedown — never a
+        drain, which finishes its work): the gateway's periodic sweep
+        re-dispatches journal entries this returns True for, catching
+        a death that raced ticket registration."""
+        return self.replica.failed
+
 
 class EngineReplica:
     """One serving engine on its own daemon thread."""
@@ -49,6 +93,8 @@ class EngineReplica:
         # per-request bookkeeping instead of retaining it forever
         engine.retain_results = False
         self.name = name
+        self.failed = False
+        self.failure: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -56,9 +102,22 @@ class EngineReplica:
         if self._thread is not None:
             return
         self._thread = threading.Thread(
-            target=self.engine.run_forever, args=(self._stop,),
-            daemon=True, name=f"mxtpu-gw-{self.name}")
+            target=self._run, daemon=True,
+            name=f"mxtpu-gw-{self.name}")
         self._thread.start()
+
+    def _run(self) -> None:
+        """Thread body: an exception escaping the engine loop is a
+        replica DEATH, not a silent thread exit — record it so the
+        supervisor (and /state) can tell a crash from a drain."""
+        try:
+            self.engine.run_forever(self._stop)
+        except BaseException as e:   # noqa: BLE001 — reported via state
+            self.failure = e
+            self.failed = True
+            telemetry.flight().record(
+                "gateway", "replica_died", replica=self.name,
+                error=repr(e)[:200])
 
     def submit(self, req: Request) -> int:
         return self.engine.submit(req)
@@ -83,10 +142,32 @@ class EngineReplica:
     def alive(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    @property
+    def healthy(self) -> bool:
+        """Routable: not failed, and its thread either hasn't started
+        yet (``started=False`` sets — work queues until ``start()``)
+        or is still running and not draining."""
+        if self.failed:
+            return False
+        if self._thread is None:
+            return not self._stop.is_set()
+        return self._thread.is_alive() and not self._stop.is_set()
+
+    def heartbeat(self) -> Dict[str, Any]:
+        """The supervisor's step-progress probe (one snapshot, no
+        lock-ordering risk: every field is read through the engine's
+        own lock or is a plain attribute)."""
+        ld = self.load()
+        return {"name": self.name, "alive": self.alive,
+                "healthy": self.healthy, "failed": self.failed,
+                "steps": self.engine.steps_run,
+                "work": ld["queued"] + ld["active"]}
+
 
 class ReplicaSet:
     """The colocated-serving backend: replicas + least-loaded routing
-    + the ``scale_to`` surface the autoscaler drives."""
+    + the ``scale_to`` surface the autoscaler drives + the
+    remove/spawn surface the supervisor drives."""
 
     def __init__(self, engine_factory: Callable[[], ServeEngine],
                  n_replicas: int = 1, *, started: bool = True):
@@ -129,16 +210,22 @@ class ReplicaSet:
     # -- routing -----------------------------------------------------------
     def route(self, req: Request,
               handoff: Optional[KVHandoff] = None) -> Ticket:
-        """Submit to the least-loaded replica. Raises RuntimeError
-        after ``close()``. Pick + submit are ONE critical section:
-        concurrent routes must see each other's submissions (two
-        racing requests both reading queued=0 would pile onto the
-        same replica), and a route racing close() must never hand a
-        request to a replica nothing will serve."""
+        """Submit to the least-loaded healthy replica. Raises
+        RuntimeError after ``close()`` and :class:`NoHealthyReplicas`
+        when every replica is dead/removed. Pick + submit are ONE
+        critical section: concurrent routes must see each other's
+        submissions (two racing requests both reading queued=0 would
+        pile onto the same replica), and a route racing close() must
+        never hand a request to a replica nothing will serve."""
         with self._lock:
-            if self._closed or not self._replicas:
+            if self._closed:
                 raise RuntimeError("replica set is closed")
-            loads = [(r, r.load()) for r in self._replicas]
+            live = [r for r in self._replicas if r.healthy]
+            if not live:
+                raise NoHealthyReplicas(
+                    f"no healthy replica to route to "
+                    f"({len(self._replicas)} registered)")
+            loads = [(r, r.load()) for r in live]
             replica, _ = min(
                 loads, key=lambda rl: (rl[1]["queued"]
                                        + rl[1]["active"])
@@ -146,6 +233,44 @@ class ReplicaSet:
             rid = (replica.submit(req) if handoff is None
                    else replica.submit_prefilled(handoff, req))
         return Ticket(replica, rid)
+
+    # -- supervisor surface -------------------------------------------------
+    def replicas(self) -> List[EngineReplica]:
+        """Routing-set snapshot (supervision + introspection)."""
+        with self._lock:
+            return list(self._replicas)
+
+    def remove_replica(self, replica: EngineReplica) -> bool:
+        """Pull a dead/stalled replica out of routing WITHOUT
+        replacing it (the supervisor decides whether/when to respawn).
+        Returns False if it was not in the routing set (already
+        removed — supervision races are benign)."""
+        with self._lock:
+            if replica not in self._replicas:
+                return False
+            self._replicas.remove(replica)
+            live = len(self._replicas)
+        # a stalled replica may still be running: signal its loop so
+        # that even if it unwedges it drains instead of serving a
+        # request the gateway has already re-dispatched elsewhere
+        replica.stop()
+        self._m_replicas.set(live)
+        return True
+
+    def spawn_replica(self) -> Optional[EngineReplica]:
+        """Start one fresh replica from the factory and add it to
+        routing (the supervisor's restart lever). None after close."""
+        with self._lock:
+            if self._closed:
+                return None
+            r = EngineReplica(self._factory(),
+                              name=f"r{next(self._seq)}")
+            if self._started:
+                r.start()
+            self._replicas.append(r)
+            live = len(self._replicas)
+        self._m_replicas.set(live)
+        return r
 
     # -- autoscaler surface ------------------------------------------------
     @property
@@ -183,16 +308,244 @@ class ReplicaSet:
     # -- introspection ------------------------------------------------------
     def load_total(self) -> Dict[str, int]:
         out = {"queued": 0, "active": 0, "slots": 0}
-        with self._lock:
-            reps = list(self._replicas)
-        for r in reps:
+        for r in self.replicas():
             ld = r.load()
             for k in out:
                 out[k] += ld[k]
         return out
 
     def state(self) -> List[Dict[str, Any]]:
+        return [dict(name=r.name, alive=r.alive, healthy=r.healthy,
+                     failed=r.failed,
+                     error=(repr(r.failure)[:120] if r.failure
+                            else None), steps=r.engine.steps_run,
+                     **r.load())
+                for r in self.replicas()]
+
+
+class ReplicaSupervisor:
+    """Health-checks every replica via step-progress heartbeats and
+    keeps the set serving: a DEAD replica (thread exited with its stop
+    event clear — an escaped exception) or a STALLED one (work
+    pending, step counter frozen past ``stall_s``) is pulled out of
+    routing, counted in ``gateway_replica_restarts_total{reason}``,
+    replaced from the factory under a bounded-restart + exponential
+    backoff budget, and reported to ``on_down(replica, reason)`` — the
+    gateway's deterministic re-dispatch hook.
+
+    The loop itself is clock-injectable and single-steppable
+    (:meth:`check`), so chaos tests drive it deterministically; the
+    background thread (:meth:`run_forever`) is the production mode.
+    """
+
+    def __init__(self, backend, *,
+                 on_down: Optional[Callable[[EngineReplica, str],
+                                            None]] = None,
+                 heartbeat_s: Optional[float] = None,
+                 stall_s: Optional[float] = None,
+                 warmup_s: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_max_s: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.backend = backend
+        self.on_down = on_down
+        self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
+                            else env_float(
+                                "MXTPU_GATEWAY_HEARTBEAT_S", 0.25,
+                                "Replica supervisor health-check "
+                                "period (seconds)."))
+        self.stall_s = (stall_s if stall_s is not None
+                        else env_float(
+                            "MXTPU_GATEWAY_STALL_S", 30.0,
+                            "A replica with pending work whose engine "
+                            "step counter does not advance for this "
+                            "many seconds is declared stalled and "
+                            "replaced."))
+        self.warmup_s = (warmup_s if warmup_s is not None
+                         else env_float(
+                             "MXTPU_GATEWAY_WARMUP_STALL_S", 120.0,
+                             "Stall threshold applied while a replica "
+                             "has completed ZERO steps: first "
+                             "admission legitimately blocks on "
+                             "prefill+decode compiles, so declaring a "
+                             "compiling replica stalled would kill "
+                             "every replacement mid-warmup forever."))
+        self.max_restarts = (max_restarts if max_restarts is not None
+                             else env_int(
+                                 "MXTPU_GATEWAY_MAX_RESTARTS", 5,
+                                 "Replica restarts the supervisor "
+                                 "will perform over the gateway's "
+                                 "life before refusing further "
+                                 "replacements (a crash loop must "
+                                 "become a loud 503, not an infinite "
+                                 "respawn)."))
+        self.backoff_base_s = (
+            backoff_base_s if backoff_base_s is not None
+            else env_float(
+                "MXTPU_GATEWAY_RESTART_BACKOFF_S", 0.05,
+                "Initial delay before a replica replacement, doubled "
+                "per consecutive restart (decays back after a quiet "
+                "period)."))
+        self.backoff_max_s = (
+            backoff_max_s if backoff_max_s is not None
+            else env_float(
+                "MXTPU_GATEWAY_RESTART_BACKOFF_MAX", 5.0,
+                "Replica-replacement backoff ceiling (seconds)."))
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        # keyed by replica NAME (unique per set, never reused — id()
+        # can be recycled by the allocator after a scale-down, which
+        # would hand a fresh replica a stale stall window)
+        self._progress: Dict[str, tuple] = {}   # name -> (steps, t)
+        self._m_restarts: Dict[str, Any] = {}
+        self.restarts = 0
+        self.history: List[Dict[str, Any]] = []   # bounded, /state
+        self._pending_spawns = 0
+        self._next_spawn_at = 0.0
+        self._consecutive = 0
+        self._last_down_t = 0.0
+
+    def _count(self, reason: str) -> None:
+        m = self._m_restarts.get(reason)
+        if m is None:
+            m = self._m_restarts[reason] = telemetry.counter(
+                "gateway_replica_restarts_total",
+                "Replica replacements by the gateway supervisor, "
+                "by failure reason", reason=reason)
+        m.inc()
+
+    # -- detection -----------------------------------------------------------
+    def _diagnose(self, replica: EngineReplica,
+                  now: float) -> Optional[str]:
+        hb = replica.heartbeat()
+        if replica._stop.is_set():
+            return None                     # draining — expected exit
+        if replica._thread is not None and not hb["alive"]:
+            return "died"
+        key = replica.name
+        last = self._progress.get(key)
+        if last is None or last[0] != hb["steps"]:
+            self._progress[key] = (hb["steps"], now)
+            return None
+        # a replica mid-warmup (zero completed steps) is most likely
+        # COMPILING its admission/decode programs, not wedged — hold
+        # it to the (much larger) warmup threshold instead
+        limit = (self.stall_s if hb["steps"] > 0
+                 else max(self.stall_s, self.warmup_s))
+        if hb["work"] > 0 and hb["alive"] \
+                and now - last[1] >= limit:
+            return "stalled"
+        if hb["work"] == 0:
+            # idle is not a stall: restart the progress window
+            self._progress[key] = (hb["steps"], now)
+        return None
+
+    def check(self) -> List[str]:
+        """One supervision pass; returns the reasons of any replicas
+        taken down this pass. Thread-safe, callable from tests."""
+        now = self._clock()
+        downs: List[tuple] = []
         with self._lock:
-            reps = list(self._replicas)
-        return [dict(name=r.name, alive=r.alive, **r.load())
-                for r in reps]
+            reps = self.backend.replicas()
+            seen = {r.name for r in reps}
+            for stale in [k for k in self._progress
+                          if k not in seen]:
+                # drained via scale_to (never passed through
+                # _take_down): drop its window or the dict grows
+                # forever under autoscaler churn
+                del self._progress[stale]
+            for r in reps:
+                reason = self._diagnose(r, now)
+                if reason is not None:
+                    downs.append((r, reason))
+        for replica, reason in downs:
+            self._take_down(replica, reason, now)
+        self._maybe_spawn(now)
+        if not downs:
+            with self._lock:
+                # decay the consecutive-failure count only after a
+                # QUIET period (no takedown for a full backoff
+                # ceiling): a serial crash loop — each replacement
+                # dying right after its spawn — must keep doubling,
+                # while one crash a day must not creep toward the max
+                if (self._consecutive and self._pending_spawns == 0
+                        and now - self._last_down_t
+                        >= self.backoff_max_s):
+                    self._consecutive = 0
+        return [reason for _, reason in downs]
+
+    def _take_down(self, replica: EngineReplica, reason: str,
+                   now: float) -> None:
+        if not self.backend.remove_replica(replica):
+            return                          # raced another pass
+        replica.failed = True               # never routable again
+        self._progress.pop(replica.name, None)
+        self._count(reason)
+        telemetry.flight().record(
+            "gateway", "replica_down", replica=replica.name,
+            reason=reason,
+            error=(repr(replica.failure)[:200] if replica.failure
+                   else None))
+        with self._lock:
+            self.history.append(
+                {"t": now, "replica": replica.name, "reason": reason,
+                 "error": (repr(replica.failure)[:120]
+                           if replica.failure else None)})
+            del self.history[:-32]
+            self._last_down_t = now
+            if self.restarts < self.max_restarts:
+                self.restarts += 1
+                self._pending_spawns += 1
+                delay = min(
+                    self.backoff_base_s * (2 ** self._consecutive),
+                    self.backoff_max_s)
+                self._consecutive += 1
+                self._next_spawn_at = max(self._next_spawn_at,
+                                          now + delay)
+            else:
+                telemetry.flight().record(
+                    "gateway", "restart_budget_exhausted",
+                    replica=replica.name, max=self.max_restarts)
+        if self.on_down is not None:
+            self.on_down(replica, reason)
+
+    def _maybe_spawn(self, now: float) -> None:
+        """Replace taken-down replicas once their backoff expires (the
+        backoff delays the SPAWN, never the re-dispatch — stranded
+        requests move to surviving replicas immediately)."""
+        while True:
+            with self._lock:
+                if self._pending_spawns <= 0 \
+                        or now < self._next_spawn_at:
+                    return
+                self._pending_spawns -= 1
+            fresh = self.backend.spawn_replica()
+            if fresh is not None:
+                telemetry.flight().record("gateway", "replica_spawned",
+                                          replica=fresh.name)
+
+    @property
+    def exhausted(self) -> bool:
+        """No replacement is coming: the restart budget is spent and
+        nothing is pending — parked re-dispatches should fail loudly
+        instead of waiting for a replica that will never exist."""
+        with self._lock:
+            return (self.restarts >= self.max_restarts
+                    and self._pending_spawns == 0)
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"restarts": self.restarts,
+                    "max_restarts": self.max_restarts,
+                    "pending_spawns": self._pending_spawns,
+                    "history": list(self.history[-8:])}
+
+    def run_forever(self, stop: threading.Event) -> None:
+        while not stop.wait(self.heartbeat_s):
+            try:
+                self.check()
+            except Exception:
+                # supervision must never die quietly; the flight ring
+                # has the event, the next heartbeat retries
+                telemetry.flight().record("gateway", "supervise_error")

@@ -1,0 +1,546 @@
+"""Serving-tier fault tolerance (ISSUE 7): replica supervision,
+deterministic re-dispatch, self-healing disagg, chaos harness.
+
+Contracts (all provoked by seeded ``ServeChaosPlan`` faults — never
+trusted):
+
+- a request that survives a replica crash emits the EXACT same tokens
+  it would have without the crash: the gateway journals (prompt,
+  params, seed, streamed prefix) and resumes on a healthy replica via
+  re-prefill with the rng chain fast-forwarded (``serve.resume_key``);
+- the supervisor detects dead/stalled replicas by step-progress
+  heartbeat, restarts within a bounded budget, and counts every event
+  in ``gateway_replica_restarts_total{reason}``;
+- zero healthy replicas is a DISTINCT failure: 503 + Retry-After at
+  the front door, parked work failed loudly once the budget is spent;
+- Retry-After values carry seeded jitter (no thundering re-herd);
+- the KV-handoff channel severed mid-handoff reconnects with backoff,
+  re-authenticates via HMAC, and the resent handoff seats the
+  bit-identical block; a wrong secret fails FAST (no retry loop);
+- a killed prefill worker is respawned with a single resubmit; a
+  persistently failing prefill path trips the circuit breaker into
+  bit-identical colocated fallback, surfaced as ``degraded`` in
+  /healthz.
+
+Everything is deterministic: the ``chaos_serve`` CI stage reruns this
+file under tools/flakiness_checker.py to prove it.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from dataclasses import replace
+
+from mxtpu import rpc, telemetry
+from mxtpu.contrib.chaos import ServeChaosPlan, attach_serve
+from mxtpu.models import llama
+from mxtpu.serve import Request, ServeEngine, resume_key
+from mxtpu.serve.gateway import (CircuitBreaker, DisaggBackend,
+                                 Gateway, GatewayClient,
+                                 GatewayUnavailable, KVChannel,
+                                 NoHealthyReplicas, ReplicaSet)
+
+# fast supervision for tests: tight heartbeat, tiny restart backoff
+SUP = dict(heartbeat_s=0.05, stall_s=30.0, backoff_base_s=0.01,
+           backoff_max_s=0.05)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                   remat=False, attn_impl="dense")
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _reference(cfg, params, prompt, mnew, seed=0, temperature=0.0,
+               top_k=None, top_p=None):
+    out = llama.generate(
+        cfg, params, jnp.asarray(prompt, jnp.int32)[None], mnew,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        rng=jax.random.PRNGKey(seed))
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("min_bucket", 4)
+    return ServeEngine(cfg, params, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the resume primitive: re-prefill past a streamed prefix, bit-exactly
+# ---------------------------------------------------------------------------
+def test_resume_key_replays_sampling_chain(cfg, params):
+    """The crux of deterministic re-dispatch: a SAMPLED request
+    resumed after n streamed tokens — prompt+prefix re-prefilled with
+    resume_key(seed, n) — continues the exact token sequence of an
+    uninterrupted run. (Greedy would hide a broken chain; temperature
+    + top_k makes every split position observable.)"""
+    prompt = (np.arange(6) * 5 + 1) % cfg.vocab_size
+    total = 8
+    ref = _reference(cfg, params, prompt, total, seed=7,
+                     temperature=0.9, top_k=7)
+    for n in (0, 1, 3):
+        resumed = np.concatenate(
+            [prompt, np.asarray(ref[:n], np.int32)])
+        eng = _engine(cfg, params)
+        rid = eng.submit(Request(
+            prompt=resumed, max_new_tokens=total - n,
+            temperature=0.9, top_k=7, seed=7,
+            rng=resume_key(7, n) if n else None))
+        res = eng.run()
+        assert list(res[rid]) == ref[n:], n
+
+
+# ---------------------------------------------------------------------------
+# tentpole (a)+(b): supervision + deterministic re-dispatch
+# ---------------------------------------------------------------------------
+def test_replica_kill_poisson_stream_bit_identical(cfg, params):
+    """THE acceptance gate: a seeded multi-client Poisson stream
+    through a 2-replica HTTP gateway with a chaos-killed replica —
+    every accepted request completes, every token list is
+    bit-identical to a fault-free per-request generate, and the
+    restart counter proves the kill actually fired."""
+    reg = telemetry.registry()
+    r0 = reg.value("gateway_replica_restarts_total", reason="died")
+    gw = Gateway(lambda: _engine(cfg, params), n_replicas=2,
+                 queue_max=256, supervisor_opts=SUP)
+    plan = attach_serve(gw, ServeChaosPlan(
+        seed=3, kill_replica={0: 2}))   # replica r0 dies at step 2
+    try:
+        port = gw.start_http(port=0)
+        rng = np.random.default_rng(17)
+        jobs, results = [], {}
+        for i in range(10):
+            plen = int(rng.choice([3, 5, 9]))
+            samp = (dict(temperature=float(rng.choice([0.7, 0.9])),
+                         top_k=int(rng.choice([5, 8])))
+                    if i % 2 else dict(temperature=0.0))
+            jobs.append(dict(
+                prompt=rng.integers(0, cfg.vocab_size, plen),
+                mnew=int(rng.choice([4, 6])), seed=i,
+                delay=float(rng.exponential(0.01)), **samp))
+
+        def client(i, job):
+            time.sleep(job["delay"])
+            cli = GatewayClient("127.0.0.1", port)
+            results[i] = cli.generate(
+                job["prompt"], job["mnew"], seed=job["seed"],
+                temperature=job.get("temperature", 0.0),
+                **({"top_k": job["top_k"]} if "top_k" in job else {}))
+
+        threads = [threading.Thread(target=client, args=(i, j))
+                   for i, j in enumerate(jobs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert plan.injected["replica_kill"] >= 1, plan.injected
+        assert len(results) == 10
+        for i, job in enumerate(jobs):
+            assert results[i]["status"] == 200, (i, results[i])
+            assert results[i]["reason"] == "complete", (i, results[i])
+            assert results[i]["tokens"] == _reference(
+                cfg, params, job["prompt"], job["mnew"],
+                seed=job["seed"],
+                temperature=job.get("temperature", 0.0),
+                top_k=job.get("top_k")), (i, job)
+        # the fault was detected, counted, and repaired
+        assert reg.value("gateway_replica_restarts_total",
+                         reason="died") - r0 >= 1
+        sup = gw.supervisor.describe()
+        assert sup["restarts"] >= 1
+        assert any(h["reason"] == "died" for h in sup["history"])
+    finally:
+        gw.close()
+
+
+def test_decode_raise_restart_history_and_state(cfg, params):
+    """A raise INSIDE decode dispatch on the only replica: the
+    supervisor restarts it, the stranded request resumes bit-identical
+    mid-stream, and /state carries the restart history + health."""
+    reg = telemetry.registry()
+    rd0 = reg.value("gateway_redispatch_total")
+    gw = Gateway(lambda: _engine(cfg, params, max_slots=1),
+                 n_replicas=1, queue_max=16, supervisor_opts=SUP)
+    plan = attach_serve(gw, ServeChaosPlan(
+        seed=1, raise_in_decode={0: 3}))
+    try:
+        prompt = np.arange(5) % cfg.vocab_size
+        h = gw.submit(prompt, 8, seed=4, temperature=0.8)
+        toks = h.result(timeout=120)
+        assert h.reason == "complete"
+        assert list(toks) == _reference(cfg, params, prompt, 8,
+                                        seed=4, temperature=0.8)
+        assert plan.injected["decode_raise"] == 1
+        assert reg.value("gateway_redispatch_total") - rd0 >= 1
+        st = gw.state()
+        sup = st["supervisor"]
+        assert sup["restarts"] >= 1
+        assert any(h_["reason"] == "died" for h_ in sup["history"])
+        assert any("ServeChaosFault" in (h_["error"] or "")
+                   for h_ in sup["history"])
+        # the replacement replica is healthy and serving
+        assert any(r["healthy"] for r in st["replicas"])
+    finally:
+        gw.close()
+
+
+def test_zero_healthy_replicas_503_and_parked_failure(cfg, params):
+    """Restart budget 0 + a dead only-replica: new submissions get the
+    DISTINCT unavailable error (HTTP 503 + Retry-After), the stranded
+    request fails loudly with reason 'error' instead of hanging, and
+    /healthz reports degraded."""
+    gw = Gateway(lambda: _engine(cfg, params, max_slots=1),
+                 n_replicas=1, queue_max=16,
+                 supervisor_opts=dict(SUP, max_restarts=0))
+    attach_serve(gw, ServeChaosPlan(seed=2, kill_replica={0: 1}))
+    try:
+        port = gw.start_http(port=0)
+        h = gw.submit(np.arange(4) % cfg.vocab_size, 8, seed=0)
+        toks = h.result(timeout=60)      # killed, never replaced
+        assert h.reason == "error" and len(toks) <= 8
+        with pytest.raises(GatewayUnavailable):
+            gw.submit(np.arange(4) % cfg.vocab_size, 2, seed=1)
+        cli = GatewayClient("127.0.0.1", port)
+        rec = cli.generate(np.arange(4) % cfg.vocab_size, 2, seed=1)
+        assert rec["status"] == 503
+        assert rec["retry_after_s"] >= 1
+        status, hz = cli.get_json("/healthz")
+        assert status == 200
+        assert hz["status"] == "degraded"
+        assert hz["healthy_replicas"] == 0
+    finally:
+        gw.close()
+
+
+def test_retry_after_jitter_spreads(cfg, params):
+    """Shed responses must not synchronize their victims: consecutive
+    Retry-After values from one overloaded gateway are jittered
+    (seeded — the SEQUENCE is reproducible, the VALUES spread)."""
+    gw = Gateway(lambda: _engine(cfg, params, max_slots=1),
+                 n_replicas=1, queue_max=2, started=False,
+                 supervise=False, retry_jitter=4.0)
+    try:
+        for i in range(2):
+            gw.submit(np.arange(4) % cfg.vocab_size, 2, seed=i)
+        values = []
+        for i in range(8):
+            try:
+                gw.submit(np.arange(4) % cfg.vocab_size, 2, seed=9)
+            except Exception as e:
+                values.append(e.retry_after)
+        assert len(values) == 8
+        assert len(set(values)) >= 2, values   # jitter spreads them
+        assert all(v >= 1 for v in values)
+        gw.backend.start()                     # drain for clean close
+    finally:
+        gw.close()
+
+
+def test_supervisor_stall_detection(cfg, params):
+    """A replica whose loop stops making step progress while holding
+    work is STALLED: the supervisor pulls it from routing (reason
+    'stalled'), restarts, and the wedged request resumes elsewhere —
+    without waiting for the stuck thread."""
+    reg = telemetry.registry()
+    s0 = reg.value("gateway_replica_restarts_total", reason="stalled")
+    gw = Gateway(lambda: _engine(cfg, params, max_slots=1),
+                 n_replicas=1, queue_max=16,
+                 supervisor_opts=dict(SUP, stall_s=0.3))
+    try:
+        replica = gw.backend.replicas()[0]
+        eng = replica.engine
+        orig = eng._dispatch
+        fired = {"n": 0}
+
+        def wedge(firsts):
+            if fired["n"] == 2:
+                fired["n"] += 1
+                time.sleep(2.5)      # wedged well past stall_s
+            else:
+                fired["n"] += 1
+            return orig(firsts)
+
+        eng._dispatch = wedge
+        prompt = np.arange(4) % cfg.vocab_size
+        h = gw.submit(prompt, 6, seed=3, temperature=0.7)
+        toks = h.result(timeout=120)
+        assert h.reason == "complete"
+        assert list(toks) == _reference(cfg, params, prompt, 6,
+                                        seed=3, temperature=0.7)
+        assert reg.value("gateway_replica_restarts_total",
+                         reason="stalled") - s0 >= 1
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# tentpole (c): self-healing disagg
+# ---------------------------------------------------------------------------
+def _tcp_channel_pair(secret):
+    """connect+accept a re-healable TCP channel pair (the cross-host
+    deployment shape: tx redials, rx re-accepts)."""
+    listener, port = KVChannel.listen("127.0.0.1", 0)
+    out = {}
+
+    def rx_side():
+        out["rx"] = KVChannel.accept(listener, secret=secret,
+                                     reaccept=True)
+
+    t = threading.Thread(target=rx_side)
+    t.start()
+    tx = KVChannel.connect("127.0.0.1", port, secret=secret)
+    t.join(30)
+    return tx, out["rx"]
+
+
+def test_kv_channel_sever_reconnect_reauth_bit_identical():
+    """Satellite: a TCP handoff channel severed mid-handoff reconnects
+    with backoff, re-authenticates via the HMAC hello, and the RESENT
+    frame's arrays are bit-identical; counters prove the reconnect
+    happened. A wrong-secret dial fails FAST with an auth error —
+    no retry loop."""
+    reg = telemetry.registry()
+    rc0 = reg.value("gateway_kv_reconnects_total")
+    rs0 = reg.value("gateway_kv_resends_total")
+    tx, rx = _tcp_channel_pair(b"kv-chaos")
+    got = []
+    done = threading.Event()
+
+    def feeder():
+        for _ in range(2):
+            got.append(rx.recv_handoff())
+        done.set()
+
+    t = threading.Thread(target=feeder, daemon=True)
+    t.start()
+    block = np.arange(48, dtype=np.float32).reshape(2, 2, 6, 2)
+    frame = ("kv", 11, 5, 42, block, block * 2,
+             np.asarray([3, 4], np.uint32))
+    tx.send_handoff(frame)
+    # sever mid-stream: the next handoff must ride a fresh,
+    # re-authenticated connection
+    tx._sock.close()
+    frame2 = ("kv", 12, 5, 43, block + 1, block * 3,
+              np.asarray([5, 6], np.uint32))
+    tx.send_handoff(frame2)
+    assert done.wait(60)
+    assert [m[1] for m in got] == [11, 12]
+    np.testing.assert_array_equal(got[1][4], block + 1)   # bit-exact
+    np.testing.assert_array_equal(got[1][5], block * 3)
+    assert got[1][4].dtype == np.float32
+    assert reg.value("gateway_kv_reconnects_total") - rc0 >= 1
+    assert reg.value("gateway_kv_resends_total") - rs0 >= 1
+    tx.close()
+    rx.close()
+
+    # auth failure fails FAST: a wrong-secret dialer gets an auth
+    # error from the handshake, not a silent retry loop
+    listener, port = KVChannel.listen("127.0.0.1", 0)
+    srv_err = {}
+
+    def rx_auth():
+        try:
+            KVChannel.accept(listener, secret=b"right")
+        except rpc.RPCAuthError as e:
+            srv_err["e"] = e
+
+    t2 = threading.Thread(target=rx_auth, daemon=True)
+    t2.start()
+    t0 = time.monotonic()
+    with pytest.raises((rpc.RPCAuthError, rpc.RPCProtocolError)):
+        KVChannel.connect("127.0.0.1", port, secret=b"wrong")
+    assert time.monotonic() - t0 < 5.0    # fast, not a backoff loop
+    t2.join(30)
+    assert isinstance(srv_err.get("e"), rpc.RPCAuthError)
+    listener.close()
+
+
+def test_prefill_worker_kill_respawn_single_resubmit(cfg, params):
+    """The DataLoader dead-worker pattern, serving edition: a chaos-
+    killed prefill worker is respawned, its in-flight job resubmitted
+    ONCE, and the request completes bit-identically."""
+    reg = telemetry.registry()
+    w0 = reg.value("gateway_prefill_restarts_total")
+    be = DisaggBackend(cfg, params, n_prefill=1, n_decode=1,
+                       max_slots=2, max_len=32, min_bucket=4)
+    gw = Gateway(backend=be, queue_max=16, supervisor_opts=SUP)
+    plan = attach_serve(gw, ServeChaosPlan(
+        seed=5, kill_prefill={0: 0}))   # dies on its first job
+    try:
+        prompt = np.arange(5) % cfg.vocab_size
+        h = gw.submit(prompt, 4, seed=6, temperature=0.9)
+        toks = h.result(timeout=120)
+        assert h.reason == "complete"
+        assert list(toks) == _reference(cfg, params, prompt, 4,
+                                        seed=6, temperature=0.9)
+        assert plan.injected["prefill_kill"] == 1
+        assert reg.value("gateway_prefill_restarts_total") - w0 == 1
+        # the pool is at size with a live replacement
+        assert len(be.prefill) == 1 and be.prefill[0].alive
+    finally:
+        gw.close()
+
+
+def test_breaker_trips_to_bit_identical_colocated_fallback(cfg,
+                                                           params):
+    """Sustained prefill failure trips the circuit breaker: requests
+    fall back to COLOCATED prefill (same graph/sampler/rng chain →
+    bit-identical), /healthz degrades, and a half-open probe after
+    cooldown closes the breaker once the pool heals."""
+    reg = telemetry.registry()
+    fb0 = reg.value("gateway_breaker_fallback_total")
+    now = {"t": 0.0}
+    breaker = CircuitBreaker(threshold=2, cooldown_s=10.0,
+                             clock=lambda: now["t"])
+    be = DisaggBackend(cfg, params, n_prefill=1, n_decode=1,
+                       max_slots=2, max_len=32, min_bucket=4,
+                       breaker=breaker)
+    gw = Gateway(backend=be, queue_max=16, supervisor_opts=SUP)
+    try:
+        port = gw.start_http(port=0)
+        worker = be.prefill[0]
+        orig_fn = worker._fn
+
+        def poisoned(bucket):
+            def f(*a, **k):
+                raise RuntimeError("injected prefill failure")
+            return f
+
+        worker._fn = poisoned
+        for i in range(2):               # 2 failures trip threshold 2
+            h = gw.submit(np.arange(4) % cfg.vocab_size, 2, seed=i)
+            h.result(timeout=60)
+            assert h.reason == "error"
+        assert breaker.describe()["state"] == "open"
+        # open breaker: requests served colocated, bit-identically
+        prompt = np.arange(6) % cfg.vocab_size
+        h = gw.submit(prompt, 3, seed=9, temperature=0.8)
+        assert list(h.result(timeout=120)) == _reference(
+            cfg, params, prompt, 3, seed=9, temperature=0.8)
+        assert h.reason == "complete"
+        assert reg.value("gateway_breaker_fallback_total") - fb0 >= 1
+        status, hz = GatewayClient("127.0.0.1", port) \
+            .get_json("/healthz")
+        assert status == 200 and hz["status"] == "degraded"
+        assert hz["breaker"]["state"] == "open"
+        # pool heals; after cooldown ONE half-open probe closes it
+        worker._fn = orig_fn
+        now["t"] = 11.0
+        h = gw.submit(prompt, 2, seed=10)
+        assert list(h.result(timeout=120)) == _reference(
+            cfg, params, prompt, 2, seed=10)
+        assert breaker.describe()["state"] == "closed"
+        _, hz = GatewayClient("127.0.0.1", port).get_json("/healthz")
+        assert hz["status"] == "ok" and hz["breaker"]["state"] == \
+            "closed"
+    finally:
+        gw.close()
+
+
+def test_disagg_chaos_stream_bit_identical_over_tcp(cfg, params):
+    """THE disagg acceptance gate: a seeded client stream through
+    disaggregated prefill/decode over an HMAC TCP channel, with an
+    injected prefill-worker kill AND severed/corrupted KV frames —
+    every request completes bit-identically; the retry counters prove
+    the faults fired."""
+    reg = telemetry.registry()
+    rc0 = reg.value("gateway_kv_reconnects_total")
+    w0 = reg.value("gateway_prefill_restarts_total")
+    tx, rx = _tcp_channel_pair(b"kv-e2e")
+    be = DisaggBackend(cfg, params, n_prefill=2, n_decode=2,
+                       max_slots=2, max_len=32, min_bucket=4,
+                       channel=(tx, rx))
+    gw = Gateway(backend=be, queue_max=64, supervisor_opts=SUP)
+    plan = attach_serve(gw, ServeChaosPlan(
+        seed=9, kill_prefill={1: 0},
+        kv_frames={1: "sever", 3: "corrupt", 4: "delay"},
+        delay_s=0.01))
+    try:
+        port = gw.start_http(port=0)
+        rng = np.random.default_rng(23)
+        jobs, results = [], {}
+        for i in range(8):
+            plen = int(rng.choice([3, 5, 9]))
+            jobs.append(dict(
+                prompt=rng.integers(0, cfg.vocab_size, plen),
+                mnew=int(rng.choice([2, 4])), seed=i,
+                temperature=float(rng.choice([0.0, 0.8]))))
+
+        def client(i, job):
+            cli = GatewayClient("127.0.0.1", port)
+            results[i] = cli.generate(job["prompt"], job["mnew"],
+                                      seed=job["seed"],
+                                      temperature=job["temperature"])
+
+        threads = [threading.Thread(target=client, args=(i, j))
+                   for i, j in enumerate(jobs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert len(results) == 8
+        for i, job in enumerate(jobs):
+            assert results[i]["status"] == 200, (i, results[i])
+            assert results[i]["reason"] == "complete", (i, results[i])
+            assert results[i]["tokens"] == _reference(
+                cfg, params, job["prompt"], job["mnew"],
+                seed=job["seed"], temperature=job["temperature"]), i
+        # the faults actually fired and were healed
+        assert plan.injected["prefill_kill"] == 1
+        assert plan.injected["kv_sever"] == 1
+        assert plan.injected["kv_corrupt"] == 1
+        assert reg.value("gateway_kv_reconnects_total") - rc0 >= 1
+        assert reg.value("gateway_prefill_restarts_total") - w0 >= 1
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: deadline-aware shedding tiers
+# ---------------------------------------------------------------------------
+def test_tier1_deadline_aware_shed_and_healthz(cfg, params):
+    """Past the soft bound the door sheds requests whose own deadline
+    cannot survive the backlog (tier 1) while still admitting patient
+    ones; /healthz surfaces the tier as degraded. At the hard bound
+    everything sheds (tier 2)."""
+    gw = Gateway(lambda: _engine(cfg, params, max_slots=1),
+                 n_replicas=1, queue_max=4, started=False,
+                 supervise=False)
+    try:
+        assert gw.health()["status"] == "ok"
+        handles = [gw.submit(np.arange(4) % cfg.vocab_size, 2,
+                             seed=i) for i in range(2)]
+        # depth 2 >= soft bound (0.5 * 4): estimated drain ~2 gens —
+        # a 0.5 s budget can't survive it -> tier-1 shed
+        with pytest.raises(Exception) as ei:
+            gw.submit(np.arange(4) % cfg.vocab_size, 2, seed=8,
+                      deadline_s=0.5)
+        assert getattr(ei.value, "tier", None) == 1
+        hz = gw.health()
+        assert hz["tier"] == 1 and hz["status"] == "degraded"
+        # a patient request (no deadline) is still admitted at tier 1
+        handles.append(gw.submit(np.arange(4) % cfg.vocab_size, 2,
+                                 seed=2))
+        handles.append(gw.submit(np.arange(4) % cfg.vocab_size, 2,
+                                 seed=3))
+        # hard bound: everything sheds, deadline or not
+        with pytest.raises(Exception) as ei:
+            gw.submit(np.arange(4) % cfg.vocab_size, 2, seed=9)
+        assert getattr(ei.value, "tier", None) == 2
+        assert gw.health()["tier"] == 2
+        gw.backend.start()
+        for i, h in enumerate(handles):
+            assert list(h.result(timeout=120)) == _reference(
+                cfg, params, np.arange(4) % cfg.vocab_size, 2, seed=i)
+    finally:
+        gw.close()

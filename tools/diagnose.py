@@ -47,15 +47,38 @@ def gateway_state(addr: str = ""):
     if status != 200:
         print(f"HTTP {status}: {state}")
         return
+    health = state.get("health") or {}
+    status = health.get("status", "?")
     print(f"replicas={state['n_replicas']}  queued={state['queued']}"
           f"/{state['queue_max']}  active={state['active']}"
-          f"/{state['slots']} slots")
+          f"/{state['slots']} slots  health={status}"
+          + (f" (shed tier {health['tier']})"
+             if health.get("tier") else ""))
     for r in state.get("replicas", []):
         role = r.get("role", "engine")
-        print(f"  {r['name']:<10} {role:<8} "
-              f"{'up' if r.get('alive') else 'DOWN':<5} "
-              f"queued={r['queued']} active={r['active']}"
-              f"/{r['slots']}")
+        up = ("up" if r.get("healthy", r.get("alive"))
+              else ("DEAD" if r.get("failed") else "down"))
+        line = (f"  {r['name']:<10} {role:<8} {up:<5} "
+                f"queued={r['queued']} active={r['active']}"
+                f"/{r['slots']}")
+        if r.get("steps") is not None:
+            line += f" steps={r['steps']}"
+        if r.get("error"):
+            line += f" error={r['error']}"
+        print(line)
+    breaker = state.get("breaker")
+    if breaker:
+        print(f"breaker: {breaker['state']} "
+              f"(failures={breaker['failures']}"
+              f"/{breaker['threshold']}, trips={breaker['trips']})")
+    sup = state.get("supervisor")
+    if sup:
+        print(f"supervisor: restarts={sup['restarts']}"
+              f"/{sup['max_restarts']} "
+              f"pending_spawns={sup['pending_spawns']}")
+        for h in sup.get("history", []):
+            print(f"  restart {h['replica']} reason={h['reason']}"
+                  + (f" error={h['error']}" if h.get("error") else ""))
     scaler = state.get("autoscaler")
     if scaler:
         print(f"autoscaler: replicas={scaler['replicas']} in "
