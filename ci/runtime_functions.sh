@@ -250,6 +250,112 @@ print(f"telemetry_smoke: OK ({len(events)} trace events, "
       f"{len(recs)} flight records, prometheus "
       f"{len(prom.splitlines())} lines)")
 PYEOF
+    # ISSUE 8 end to end, across REAL process boundaries: a
+    # fresh-process disagg gateway federating two fresh-process
+    # metrics peers serves one traced HTTP request; the driver then
+    # (a) stitches the gateway process's per-process trace stream
+    # into a chrome-trace timeline via the diagnose CLI and (b)
+    # validates the federated /metrics scrape — >= 3 `process` labels
+    # under strict Prometheus grammar.
+    python - << 'PYEOF'
+import json, os, subprocess, sys, tempfile, time
+tmp = tempfile.mkdtemp()
+# the child scripts live under the tmp dir: the repo root must reach
+# their sys.path explicitly (a stdin heredoc gets cwd for free)
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           MXTPU_TELEMETRY_TRACE_DIR=tmp,
+           PYTHONPATH=os.getcwd() + os.pathsep
+           + os.environ.get("PYTHONPATH", ""))
+
+peer_src = r"""
+import sys, time
+from mxtpu import telemetry as tm
+role = sys.argv[1]
+tm.counter("ci_peer_total", "per-process federation probe").inc(2)
+srv = tm.RegistryServer(port=0, process=role)
+print(srv.port, flush=True)
+time.sleep(600)
+"""
+gw_src = r"""
+import sys
+import jax, jax.numpy as jnp, numpy as np
+from dataclasses import replace
+from mxtpu import telemetry as tm
+from mxtpu.models import llama
+from mxtpu.serve.gateway import DisaggBackend, Gateway
+tm.set_process_role("gateway")
+tm.counter("ci_peer_total", "per-process federation probe").inc(1)
+peers = [("127.0.0.1", int(p)) for p in sys.argv[1:]]
+cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32, remat=False,
+              attn_impl="dense")
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+be = DisaggBackend(cfg, params, n_prefill=1, n_decode=1, max_slots=2,
+                   max_len=32, min_bucket=4)
+gw = Gateway(backend=be, queue_max=16, federate=peers)
+print(gw.start_http(port=0), flush=True)
+import time; time.sleep(600)
+"""
+for name, src in (("peer.py", peer_src), ("gw.py", gw_src)):
+    open(os.path.join(tmp, name), "w").write(src)
+
+procs = []
+try:
+    ports = []
+    for role in ("prefill_host", "kvstore"):
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(tmp, "peer.py"), role],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(p)
+        ports.append(int(p.stdout.readline()))
+    gwp = subprocess.Popen(
+        [sys.executable, os.path.join(tmp, "gw.py")]
+        + [str(p) for p in ports],
+        stdout=subprocess.PIPE, text=True, env=env)
+    procs.append(gwp)
+    gw_port = int(gwp.stdout.readline())
+
+    from mxtpu.serve.gateway import GatewayClient
+    from mxtpu.telemetry import parse_prometheus
+    cli = GatewayClient("127.0.0.1", gw_port, timeout=300.0)
+    rec = cli.generate(list(range(1, 6)), 4, seed=3, temperature=0.8)
+    assert rec["status"] == 200 and rec["reason"] == "complete", rec
+    assert len(rec["tokens"]) == 4 and rec["trace_id"], rec
+
+    # (a) stitched timeline through the CLI, valid chrome-trace JSON
+    out = os.path.join(tmp, "timeline.json")
+    r = subprocess.run(
+        [sys.executable, "tools/diagnose.py", "timeline",
+         rec["trace_id"], "--dir", tmp, "--out", out],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    tl = json.load(open(out))
+    names = {e["name"] for e in tl}
+    assert {"gateway.submit", "gateway.prefill", "serve.seat",
+            "serve.done"} <= names, names
+    assert all(e["ph"] == "M" or ("ts" in e and "pid" in e)
+               for e in tl)
+
+    # (b) federated scrape: strict grammar, >= 3 process labels,
+    # aggregate == sum for the probe counter planted in every process
+    status, text = cli.get_text("/metrics")
+    assert status == 200
+    parsed = parse_prometheus(text)
+    s = parsed["samples"]
+    procs_seen = {dict(k[1]).get("process") for k in s
+                  if dict(k[1]).get("process")}
+    assert {"gateway", "prefill_host", "kvstore"} <= procs_seen, \
+        procs_seen
+    total = s[("mxtpu_ci_peer_total", ())]
+    parts = [s[("mxtpu_ci_peer_total", (("process", p),))]
+             for p in ("gateway", "prefill_host", "kvstore")]
+    assert total == sum(parts) == 5.0, (total, parts)
+    print(f"telemetry_smoke (distributed): OK — timeline "
+          f"{len(tl)} events, federated scrape across "
+          f"{len(procs_seen)} processes")
+finally:
+    for p in procs:
+        p.kill()
+PYEOF
 }
 
 opperf_gate() {

@@ -44,7 +44,8 @@ from .base import env_int
 
 __all__ = ["RPCAuthError", "RPCProtocolError", "encode", "decode",
            "send_msg", "recv_msg", "max_frame_bytes", "MAC_SIZE",
-           "connect_with_backoff"]
+           "connect_with_backoff", "attach_context", "split_context",
+           "CTX_TAG", "CTX_VERSION"]
 
 _LEN = struct.Struct("<Q")
 _I = struct.Struct("<q")
@@ -221,6 +222,37 @@ def decode(buf: bytes) -> Any:
     if pos != len(buf):
         raise RPCProtocolError("trailing bytes in rpc frame")
     return msg
+
+
+# ---- trace-context header (distributed request tracing, ISSUE 8) ----
+# A VERSIONED wrapper any framed message can ride inside:
+#     (CTX_TAG, CTX_VERSION, ctx_tuple, payload)
+# carrying the request's TraceContext wire tuple across process
+# boundaries (the disagg KV handoff is the first consumer). The
+# version discipline: old frames (no wrapper) decode unchanged
+# through split_context; a frame from a NEWER sender (unknown
+# version) keeps its payload usable and only drops the context —
+# fields are only ever APPENDED to the ctx tuple, never moved.
+CTX_TAG = "mxctx"
+CTX_VERSION = 1
+
+
+def attach_context(msg: Any, ctx: Tuple) -> tuple:
+    """Wrap one message body with the trace-context header (``ctx``
+    is a wire-safe tuple — ``TraceContext.to_wire()``)."""
+    return (CTX_TAG, CTX_VERSION, tuple(ctx), msg)
+
+
+def split_context(msg: Any) -> Tuple[Any, Optional[tuple]]:
+    """``(payload, ctx_tuple_or_None)``. A message without the header
+    — every pre-ISSUE-8 frame — passes through untouched, so every
+    receiver can split unconditionally."""
+    if (isinstance(msg, tuple) and len(msg) == 4
+            and msg[0] == CTX_TAG and isinstance(msg[1], int)):
+        ctx = msg[2] if msg[1] == CTX_VERSION else None
+        return msg[3], (tuple(ctx) if isinstance(ctx, (tuple, list))
+                        else None)
+    return msg, None
 
 
 def connect_with_backoff(dial: Callable[[], socket.socket],

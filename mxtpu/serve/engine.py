@@ -50,6 +50,7 @@ import jax
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import distributed as dtrace
 from ..models import llama
 
 __all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for",
@@ -132,7 +133,11 @@ class Request:
     crash-recovery re-dispatch prefills ``prompt + already-streamed
     tokens`` with the chain fast-forwarded past them
     (:func:`resume_key`), so the resumed stream replays the exact
-    sampling chain a fault-free run would have used."""
+    sampling chain a fault-free run would have used. ``ctx``, when
+    set, is the request's :class:`~mxtpu.telemetry.TraceContext`:
+    every per-request span/instant the engine records (seat, prefill,
+    finalize) carries its trace_id, so a multi-hop serving path
+    stitches into one timeline."""
     prompt: Any
     max_new_tokens: int
     temperature: float = 0.0
@@ -144,6 +149,7 @@ class Request:
     on_done: Optional[Callable[[int, str], None]] = None
     deadline_s: Optional[float] = None
     rng: Optional[Any] = None
+    ctx: Optional[Any] = None
 
 
 def cancel_counter(reason: str):
@@ -233,6 +239,10 @@ class ServeEngine:
                            else _env_int("MXTPU_SERVE_MIN_BUCKET", 16))
         self.overlap = (os.environ.get("MXTPU_SERVE_OVERLAP", "1")
                         != "0") if overlap is None else bool(overlap)
+        # the engine's name in per-request trace events (EngineReplica
+        # overwrites it with the replica name, so a request that moves
+        # replicas shows WHICH bank served each segment)
+        self.role = "engine"
 
         state = llama.init_slot_cache(cfg, self.max_slots,
                                       self.max_len, mesh=mesh)
@@ -396,6 +406,10 @@ class ServeEngine:
             telemetry.flight().record("serve", "cancelled", rid=rid,
                                       reason=reason)
         req = self._requests[rid]
+        if req.ctx is not None:
+            with dtrace.use(req.ctx):
+                telemetry.instant("serve.done", reason=reason,
+                                  role=self.role)
         if req.on_done is not None:
             req.on_done(rid, reason)
         if not self.retain_results:
@@ -452,6 +466,12 @@ class ServeEngine:
             slot = int(free[0])
             self._m["wait"].observe(max(0, self._step_idx - arrival))
             self._seat(slot, rid, req)
+            if req.ctx is not None:
+                # once per admission, not per token: the timeline's
+                # "which bank, which slot, when" anchor for this hop
+                with dtrace.use(req.ctx):
+                    telemetry.instant("serve.seat", slot=slot,
+                                      role=self.role)
             picks.append((slot, rid, req,
                           self._handoffs.pop(rid, None)))
         self._m["queue"].set(len(self._queue))
@@ -463,12 +483,13 @@ class ServeEngine:
         """Run the admission programs for already-seated picks (engine
         thread only — slot/cache state is loop-private)."""
         for slot, rid, req, handoff in picks:
-            if handoff is not None:
-                firsts.append(
-                    (rid, self._inject_into(slot, handoff)))
-            else:
-                firsts.append(
-                    (rid, self._prefill_into(slot, req)))
+            with dtrace.use(req.ctx):
+                if handoff is not None:
+                    firsts.append(
+                        (rid, self._inject_into(slot, handoff)))
+                else:
+                    firsts.append(
+                        (rid, self._prefill_into(slot, req)))
 
     def _prefill_into(self, slot: int, req: Request):
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
@@ -488,7 +509,7 @@ class ServeEngine:
         # prefill bucket once per crash re-dispatch
         key = (jax.random.PRNGKey(req.seed) if req.rng is None
                else jax.numpy.asarray(np.asarray(req.rng, np.uint32)))
-        with self._span_prefill(bucket=bucket):
+        with self._span_prefill(bucket=bucket, role=self.role):
             tok, self._kv, self._sv = fn(
                 self.params, padded, np.int32(prompt.size),
                 np.int32(slot), self._kv, self._sv,
@@ -513,7 +534,8 @@ class ServeEngine:
                                 mesh=self.mesh), donate_argnums=(6,)),
                 f"serve_inject_b{bucket}", expected=1)
             self._injects[bucket] = fn
-        with self._span_prefill(bucket=bucket, inject=True):
+        with self._span_prefill(bucket=bucket, inject=True,
+                                role=self.role):
             self._kv, self._sv = fn(
                 h.k, h.v, np.int32(h.true_len), np.int32(slot),
                 np.int32(h.token), np.asarray(h.rng, np.uint32),

@@ -38,6 +38,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ... import telemetry
+from ...telemetry.registry import interval_percentile
 
 __all__ = ["AutoscalePolicy", "Autoscaler", "interval_p99"]
 
@@ -62,29 +63,12 @@ class AutoscalePolicy:
 
 def interval_p99(bounds, prev_counts: Optional[List[int]],
                  counts: List[int], q: float = 99.0) -> Optional[float]:
-    """Percentile of the observations that landed BETWEEN two
-    cumulative-bucket snapshots (same interpolation as
-    ``Histogram.percentile``, applied to the diff). None when the
-    window is empty."""
-    if prev_counts is None:
-        return None
-    d = [c - p for c, p in zip(counts, prev_counts)]
-    total = sum(d)
-    if total <= 0:
-        return None
-    target = q / 100.0 * total
-    cum = 0.0
-    upper = bounds[-1]
-    for i, c in enumerate(d):
-        if c == 0:
-            continue
-        lower = bounds[i - 1] if i > 0 else 0.0
-        upper = bounds[i] if i < len(bounds) else bounds[-1]
-        if cum + c >= target:
-            frac = (target - cum) / c
-            return lower + frac * (upper - lower)
-        cum += c
-    return upper
+    """Windowed p99 between two cumulative-bucket snapshots. The
+    bucket-diff math moved to ``telemetry.registry
+    .interval_percentile`` when the SLO gauges became its second
+    consumer (ISSUE 8 satellite: one copy, shared); this name stays
+    as the autoscaler's established alias."""
+    return interval_percentile(bounds, prev_counts, counts, q)
 
 
 class Autoscaler:

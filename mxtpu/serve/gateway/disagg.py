@@ -54,6 +54,7 @@ import numpy as np
 
 from ... import rpc, telemetry
 from ...base import env_float, env_int, env_str
+from ...telemetry import distributed as dtrace
 from ...models import llama
 from ..engine import (KVHandoff, Request, ServeEngine, bucket_for,
                       cancel_counter)
@@ -313,10 +314,13 @@ class KVChannel:
             try:
                 with self._recv_lock:
                     msg, _ = rpc.recv_msg(self._sock, self._secret)
-                if (isinstance(msg, tuple) and len(msg) >= 2
-                        and msg[0] in ("kv", "kverr")):
+                # ack on the PAYLOAD: a frame wrapped in the ISSUE-8
+                # trace-context header acks exactly like a bare one
+                inner, _ctx = rpc.split_context(msg)
+                if (isinstance(inner, tuple) and len(inner) >= 2
+                        and inner[0] in ("kv", "kverr")):
                     with self._send_lock:
-                        rpc.send_msg(self._sock, ("kvack", msg[1]),
+                        rpc.send_msg(self._sock, ("kvack", inner[1]),
                                      self._secret)
                 return msg
             except (rpc.RPCAuthError, rpc.RPCProtocolError) as e:
@@ -584,6 +588,12 @@ class PrefillWorker:
                 self._current = None
 
     def _one(self, rid: int, req: Request) -> None:
+        # this hop gets its own trace segment; the handoff frame
+        # carries it across the wire (versioned rpc context header),
+        # so a decode host in ANOTHER process continues the trace
+        ctx = getattr(req, "ctx", None)
+        if ctx is not None:
+            ctx = ctx.child()
         try:
             prompt = np.asarray(req.prompt, np.int32).reshape(-1)
             bucket = bucket_for(prompt.size, self.min_bucket,
@@ -596,7 +606,8 @@ class PrefillWorker:
             key = (jax.random.PRNGKey(req.seed) if req.rng is None
                    else jax.numpy.asarray(np.asarray(req.rng,
                                                      np.uint32)))
-            with self._span(bucket=bucket):
+            with dtrace.use(ctx), self._span(bucket=bucket,
+                                             worker=self.name):
                 tok, kb, vb, rng = self._fn(bucket)(
                     self.params, padded, np.int32(prompt.size),
                     key,
@@ -609,7 +620,10 @@ class PrefillWorker:
                           true_len=int(prompt.size),
                           token=int(np.asarray(tok)[0]),
                           rng=np.asarray(rng, np.uint32))
-            self.channel.send_handoff(handoff_to_wire(rid, h))
+            frame = handoff_to_wire(rid, h)
+            if ctx is not None:
+                frame = rpc.attach_context(frame, ctx.to_wire())
+            self.channel.send_handoff(frame)
         except rpc.RPCAuthError:
             raise                   # misconfiguration: die loudly
         except (ConnectionError, OSError) as e:
@@ -880,6 +894,10 @@ class DisaggBackend:
                 msg = self._rx.recv_handoff()
             except (ConnectionError, OSError):
                 return                      # channel closed: shutdown
+            # frames from an ISSUE-8 sender carry the trace context
+            # in the versioned header; older frames split to (msg,
+            # None) and everything below behaves exactly as before
+            msg, wire_ctx = rpc.split_context(msg)
             if (isinstance(msg, tuple) and len(msg) == 3
                     and msg[0] == "kverr"):
                 rid, err = int(msg[1]), msg[2]
@@ -909,6 +927,18 @@ class DisaggBackend:
                 continue    # cancelled while prefilling, or a resent
                 #             duplicate whose first copy already seated
             req, ticket, t_submit = entry
+            if getattr(req, "ctx", None) is None and wire_ctx:
+                # cross-process decode host: the request object was
+                # rebuilt here, so the trace identity arrives on the
+                # WIRE — adopt it and the engine's seat/done events
+                # join the same trace
+                try:
+                    req.ctx = dtrace.TraceContext.from_wire(wire_ctx)
+                except ValueError:
+                    pass
+            with dtrace.use(getattr(req, "ctx", None)):
+                telemetry.instant("gateway.handoff_recv",
+                                  true_len=int(handoff.true_len))
             self.breaker.record_success()
             if reason is None and req.deadline_s is not None:
                 # the budget started at SUBMIT, not at seating: a

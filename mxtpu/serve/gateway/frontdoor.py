@@ -32,7 +32,6 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
-from ... import telemetry
 from .gateway import Gateway, GatewayOverloaded, GatewayUnavailable
 
 __all__ = ["serve_http", "GatewayClient"]
@@ -66,8 +65,9 @@ class _Handler(BaseHTTPRequestHandler):
             # on "status" ("ok" / "degraded"), humans read the rest
             self._json(200, self.gw.health())
         elif self.path == "/metrics":
-            self.gw.refresh_gauges()
-            body = telemetry.prometheus().encode()
+            # federated when peers are configured: the fleet view
+            # with per-process labels, the plain local dump otherwise
+            body = self.gw.metrics_text().encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")
@@ -90,7 +90,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": f"bad json: {e}"})
             return
         try:
-            handle = self.gw.submit_dict(body)
+            # an upstream proxy's trace id joins this request to a
+            # larger trace; absent, the gateway mints one — either
+            # way the response carries it back for correlation
+            handle = self.gw.submit_dict(
+                body, trace_id=self.headers.get("X-Mxtpu-Trace"))
         except GatewayOverloaded as e:
             self._json(429, {"error": str(e),
                              "retry_after_s": e.retry_after},
@@ -118,7 +122,8 @@ class _Handler(BaseHTTPRequestHandler):
                                           "gateway"})
                 return
             self._json(200, {"tokens": [int(t) for t in toks],
-                             "reason": handle.reason})
+                             "reason": handle.reason,
+                             "trace_id": handle.trace_id})
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
@@ -130,7 +135,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.flush()
             self.wfile.write(json.dumps(
                 {"done": True, "reason": handle.reason,
-                 "tokens": handle.tokens}).encode() + b"\n")
+                 "tokens": handle.tokens,
+                 "trace_id": handle.trace_id}).encode() + b"\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             # the slow-client story: a dead consumer must not hold a
@@ -234,13 +240,16 @@ class GatewayClient:
                 if "retry-after" in headers:
                     rec["retry_after_s"] = int(headers["retry-after"])
                 return rec
+            trace_id = None
             for line in f:
                 evt = json.loads(line)
                 if evt.get("done"):
                     reason = evt.get("reason")
                     tokens = [int(t) for t in evt["tokens"]]
+                    trace_id = evt.get("trace_id")
                     break
                 times.append(time.perf_counter())
                 tokens.append(int(evt["token"]))
         return {"status": status, "t0": t0, "tokens": tokens,
-                "times": times[:len(tokens)], "reason": reason}
+                "times": times[:len(tokens)], "reason": reason,
+                "trace_id": trace_id}

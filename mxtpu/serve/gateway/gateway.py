@@ -47,7 +47,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ... import telemetry
-from ...base import env_float, env_int
+from ...base import env_float, env_int, env_str
+from ...telemetry import distributed as dtrace
 from ..engine import Request, ServeEngine, cancel_counter, resume_key
 from .replica import (NoHealthyReplicas, ReplicaSet, ReplicaSupervisor,
                       Ticket)
@@ -99,7 +100,7 @@ class _JournalEntry:
 
     __slots__ = ("gid", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "seed", "deadline_abs", "handle",
-                 "ticket", "epoch", "done", "cancel_reason")
+                 "ticket", "epoch", "done", "cancel_reason", "ctx")
 
     def __init__(self, gid: int, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float,
@@ -119,6 +120,7 @@ class _JournalEntry:
         self.epoch = 0
         self.done = False
         self.cancel_reason: Optional[str] = None
+        self.ctx: Optional[dtrace.TraceContext] = None
 
 
 class RequestHandle:
@@ -136,14 +138,20 @@ class RequestHandle:
         self.tokens: list = []
         self.reason: Optional[str] = None
         self.ticket: Optional[Ticket] = None
+        self.trace_id: Optional[str] = None
         self._entry: Optional[_JournalEntry] = None
 
     # engine-side callbacks (never block: queue puts + list appends)
     def _on_token(self, rid: int, token: int) -> None:
         if self._first_at is None:
             self._first_at = time.perf_counter()
-            self._gw._m_ttft.observe(
-                1e3 * (self._first_at - self._submitted_at))
+            ttft_ms = 1e3 * (self._first_at - self._submitted_at)
+            self._gw._m_ttft.observe(ttft_ms)
+            entry = self._entry
+            if entry is not None and entry.ctx is not None:
+                with dtrace.use(entry.ctx):
+                    telemetry.instant("gateway.first_token",
+                                      ttft_ms=round(ttft_ms, 3))
         self.tokens.append(int(token))
         self._q.put(int(token))
 
@@ -205,6 +213,7 @@ class Gateway:
                  supervise: bool = True,
                  supervisor_opts: Optional[Dict[str, Any]] = None,
                  retry_jitter: Optional[float] = None,
+                 federate=None,
                  clock: Optional[Callable[[], float]] = None):
         if (backend is None) == (engine_factory is None):
             raise ValueError(
@@ -269,6 +278,21 @@ class Gateway:
             "gateway_redispatch_total",
             "In-flight requests moved off a failed replica and "
             "resumed on a healthy one")
+        # metrics federation: peer processes (prefill workers on
+        # other hosts, a kvstore server, sibling replicas) exposing
+        # their registry via telemetry.RegistryServer; this gateway's
+        # /metrics merges them under a `process` label
+        if federate is None:
+            federate = env_str(
+                "MXTPU_TELEMETRY_FEDERATE", "",
+                "Comma-separated host:port list of peer "
+                "RegistryServer endpoints the gateway /metrics "
+                "federates (per-process series labelled "
+                "process=<role>, plus exact aggregate series).")
+        self._federate = self._parse_peers(federate)
+        self._fed_secret = env_str("MXTPU_GATEWAY_SECRET", "").encode()
+        # derived SLO gauges + burn rate (None unless a target is set)
+        self.slo = dtrace.SLOTracker.from_env(clock=self._clock)
         self._http = None
         self._scaler = None
         self._scaler_stop: Optional[threading.Event] = None
@@ -291,6 +315,34 @@ class Gateway:
             threading.Thread(target=self._scaler.run_forever,
                              args=(self._scaler_stop,), daemon=True,
                              name="mxtpu-gw-autoscale").start()
+
+    @staticmethod
+    def _parse_peers(spec) -> List[tuple]:
+        """Accepts "host:port,host:port" (env form) or a list of
+        strings / (host, port) pairs (constructor form)."""
+        if not spec:
+            return []
+        items = ([s for s in spec.split(",") if s.strip()]
+                 if isinstance(spec, str) else list(spec))
+        peers = []
+        for item in items:
+            if isinstance(item, str):
+                host, _, port = item.strip().rpartition(":")
+                peers.append((host or "127.0.0.1", int(port)))
+            else:
+                peers.append((item[0], int(item[1])))
+        return peers
+
+    @staticmethod
+    def _ticket_replica_name(ticket) -> Optional[str]:
+        """Best-effort replica name behind a ticket (colocated Ticket
+        or a seated disagg ticket) — the redispatch span's old/new
+        endpoints."""
+        rep = getattr(ticket, "replica", None)
+        if rep is None:
+            rep = getattr(getattr(ticket, "seated", None),
+                          "replica", None)
+        return getattr(rep, "name", None)
 
     def _count(self, code: str) -> None:
         m = self._m_requests.get(code)
@@ -316,12 +368,19 @@ class Gateway:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None, seed: int = 0,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Admission-check + journal + route; returns the streaming
         handle. Raises :class:`GatewayOverloaded` past the queue bound
         (or the tier-1 deadline shed), :class:`GatewayUnavailable`
         when no healthy replica exists, and ``ValueError`` on invalid
-        parameters (the front door maps these to 429 / 503 / 400)."""
+        parameters (the front door maps these to 429 / 503 / 400).
+        ``trace_id`` (plausible hex, e.g. an upstream proxy's) is
+        honored; otherwise a fresh trace is minted — either way the
+        request carries ONE :class:`~mxtpu.telemetry.TraceContext`
+        across every hop of its life, crash re-dispatch included
+        (``handle.trace_id`` is the key ``tools/diagnose.py
+        timeline`` stitches on)."""
         handle = RequestHandle(self, time.perf_counter())
         deadline = (deadline_s if deadline_s is not None
                     else self.default_deadline_s)
@@ -366,11 +425,23 @@ class Gateway:
                     (None if deadline is None
                      else self._clock() + float(deadline)),
                     handle)
+                # the trace is minted HERE, at the front door: every
+                # hop after this point (engine seat, prefill worker,
+                # KV frame, crash re-dispatch) inherits this identity
+                entry.ctx = dtrace.mint(
+                    rid=entry.gid, seed=int(seed),
+                    deadline_abs=entry.deadline_abs or 0.0,
+                    trace_id=trace_id)
+                handle.trace_id = entry.ctx.trace_id
                 handle._entry = entry
                 self._journal[entry.gid] = entry
             req = self._build_request(entry, deadline_s=deadline)
             try:
-                ticket = self.backend.route(req)
+                with dtrace.use(entry.ctx), telemetry.span(
+                        "gateway.submit",
+                        prompt_len=int(entry.prompt.size),
+                        max_new_tokens=int(max_new_tokens)):
+                    ticket = self.backend.route(req)
             except NoHealthyReplicas as e:
                 with self._jlock:
                     self._journal.pop(entry.gid, None)
@@ -437,11 +508,13 @@ class Gateway:
             temperature=entry.temperature, top_k=entry.top_k,
             top_p=entry.top_p, seed=entry.seed, rng=rng,
             on_token=on_token, on_done=on_done,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, ctx=entry.ctx)
 
-    def submit_dict(self, body: Dict[str, Any]) -> RequestHandle:
+    def submit_dict(self, body: Dict[str, Any],
+                    trace_id: Optional[str] = None) -> RequestHandle:
         """The front door's JSON surface: validates types, forwards
-        known fields."""
+        known fields. ``trace_id`` joins an upstream trace (the
+        ``X-Mxtpu-Trace`` header or the body's ``trace_id`` field)."""
         if not isinstance(body, dict):
             raise ValueError("body must be a JSON object")
         if "prompt" not in body:
@@ -456,7 +529,8 @@ class Gateway:
             temperature=float(body.get("temperature", 0.0)),
             top_k=body.get("top_k"), top_p=body.get("top_p"),
             seed=int(body.get("seed", 0)),
-            deadline_s=body.get("deadline_s"))
+            deadline_s=body.get("deadline_s"),
+            trace_id=trace_id or body.get("trace_id"))
 
     # -- fault recovery ------------------------------------------------------
     def _cancel_entry(self, entry: _JournalEntry,
@@ -515,6 +589,13 @@ class Gateway:
                     entry.epoch += 1
                     emitted = list(entry.handle.tokens)
                     deadline_abs = entry.deadline_abs
+                    old_replica = self._ticket_replica_name(
+                        entry.ticket)
+                    if entry.ctx is not None:
+                        # SAME trace, new segment: the resumed hops
+                        # parent to the redispatch, not the original
+                        # submit — the timeline shows the seam
+                        entry.ctx = entry.ctx.child()
             if cancelled is not None:
                 cancel_counter(cancelled).inc()
                 entry.handle._on_done(-1, cancelled)
@@ -545,7 +626,16 @@ class Gateway:
             req = self._build_request(entry, deadline_s=deadline_s,
                                       emitted=emitted)
             try:
-                ticket = self.backend.route(req)
+                # the explicit crash seam in the request's ONE trace:
+                # a `gateway.redispatch` span naming the replica the
+                # request died on and the one it resumes on
+                with dtrace.use(entry.ctx), telemetry.span(
+                        "gateway.redispatch",
+                        old_replica=old_replica,
+                        emitted=len(emitted)) as rd_span:
+                    ticket = self.backend.route(req)
+                    rd_span.args["new_replica"] = \
+                        self._ticket_replica_name(ticket)
             except NoHealthyReplicas:
                 sup = self.supervisor
                 if sup is None or sup.exhausted:
@@ -593,6 +683,11 @@ class Gateway:
         while not stop.wait(sup.heartbeat_s):
             try:
                 sup.check()
+                if self.slo is not None:
+                    # rate-limited internally to the SLO window — the
+                    # heartbeat just guarantees the window advances
+                    # even when nothing scrapes /metrics
+                    self.slo.tick()
                 check_pools = getattr(self.backend, "check_pools",
                                       None)
                 if check_pools is not None:
@@ -632,15 +727,34 @@ class Gateway:
         scrape endpoints re-read the source before exporting."""
         self._m_depth.set(self.backend.load_total()["queued"])
 
+    def metrics_text(self) -> str:
+        """GET /metrics body. With federation peers configured
+        (``federate=`` / ``MXTPU_TELEMETRY_FEDERATE``) the scrape is
+        the MERGED fleet view: every process's series under a
+        ``process`` label plus exact aggregate series (counters
+        summed, histogram buckets merged, gauges last-write);
+        without peers it is the plain process-local dump, unchanged.
+        The SLO window also advances here — scrape cadence IS the
+        natural window clock."""
+        self.refresh_gauges()
+        if self.slo is not None:
+            self.slo.tick()
+        if self._federate:
+            return dtrace.federate_text(
+                telemetry.registry(), self._federate,
+                process=telemetry.process_role(),
+                secret=self._fed_secret)
+        return telemetry.prometheus()
+
     def _breaker_snapshot(self) -> Optional[Dict[str, Any]]:
         breaker_state = getattr(self.backend, "breaker_state", None)
         return breaker_state() if breaker_state is not None else None
 
     def health(self) -> Dict[str, Any]:
         """GET /healthz body: liveness plus the DEGRADATION story — the
-        current shed tier, breaker state (disagg), restart budget —
-        so a load balancer (or an operator) sees 'alive but degraded'
-        instead of a binary."""
+        current shed tier, breaker state (disagg), restart budget, SLO
+        burn — so a load balancer (or an operator) sees 'alive but
+        degraded' instead of a binary."""
         return self._health(self.backend.load_total(),
                             self._breaker_snapshot(),
                             self.supervisor.describe()
@@ -659,17 +773,27 @@ class Gateway:
         has_replicas = hasattr(self.backend, "replicas")
         replicas = self.backend.replicas() if has_replicas else []
         healthy = sum(1 for r in replicas if r.healthy)
+        slo = None
+        if self.slo is not None:
+            # a deployment may poll ONLY /healthz (no scraper, no
+            # supervisor): the window must advance here too — tick()
+            # is rate-limited to window_s, so probe traffic cannot
+            # chop it into noise
+            self.slo.tick()
+            slo = self.slo.describe()
         degraded = (tier > 0
                     or (has_replicas and healthy == 0)
                     or (breaker is not None
                         and breaker.get("state") != "closed")
-                    or bool(sup and sup["pending_spawns"]))
+                    or bool(sup and sup["pending_spawns"])
+                    or bool(slo and slo["breached"]))
         return {"ok": True,
                 "status": "degraded" if degraded else "ok",
                 "tier": tier, "queued": depth,
                 "queue_max": self.queue_max,
                 "healthy_replicas": healthy,
-                "breaker": breaker, "supervisor": sup}
+                "breaker": breaker, "supervisor": sup,
+                "slo": slo}
 
     def state(self) -> Dict[str, Any]:
         """Live topology snapshot (GET /state; tools/diagnose.py).

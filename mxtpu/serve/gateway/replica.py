@@ -92,6 +92,9 @@ class EngineReplica:
         # on_token/on_done callbacks, so the engine must prune its
         # per-request bookkeeping instead of retaining it forever
         engine.retain_results = False
+        # per-request trace events name the REPLICA, not "engine":
+        # a crash-resumed request's timeline must show both banks
+        engine.role = name
         self.name = name
         self.failed = False
         self.failure: Optional[BaseException] = None

@@ -34,9 +34,11 @@ from typing import Optional, Sequence
 
 from ..base import env_bool
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       escape_label_value, interval_percentile,
                        BYTES_BUCKETS, LATENCY_MS_BUCKETS,
                        SECONDS_BUCKETS)
-from .flight import FlightRecorder, default_flight_path
+from .flight import (FlightRecorder, default_flight_path,
+                     process_role, set_process_role)
 from . import tracing as _tracing
 from .tracing import (Span, clear_trace, current_depth, dump_trace,
                       trace_events)
@@ -45,12 +47,16 @@ from .watcher import install as install_compile_listener
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "FlightRecorder", "Span", "WatchedFunction",
+    "FlightRecorder", "Span", "WatchedFunction", "TraceContext",
+    "RegistryServer", "SLOTracker",
     "counter", "gauge", "histogram", "span", "span_factory", "instant",
     "registry", "flight", "enabled", "enable", "reset",
     "prometheus", "summary", "dump_trace", "trace_events",
     "clear_trace", "current_depth", "describe_args", "watch",
     "install_compile_listener", "default_flight_path",
+    "process_role", "set_process_role", "escape_label_value",
+    "interval_percentile", "federate_text", "parse_prometheus",
+    "distributed",
     "LATENCY_MS_BUCKETS", "BYTES_BUCKETS", "SECONDS_BUCKETS",
 ]
 
@@ -179,3 +185,11 @@ def reset() -> None:
     _REGISTRY.reset()
     clear_trace()
     _FLIGHT.clear()
+
+
+# the distributed layer registers the tracing context provider at
+# import; imported LAST — it reads this module's registry lazily
+from . import distributed                                  # noqa: E402
+from .distributed import (TraceContext, RegistryServer,    # noqa: E402
+                          SLOTracker, federate_text,
+                          parse_prometheus)

@@ -21,18 +21,60 @@ from typing import Any, Deque, Dict, List, Optional
 
 from ..base import atomic_write, env_int, env_str
 
-__all__ = ["FlightRecorder", "default_flight_path"]
+__all__ = ["FlightRecorder", "default_flight_path", "process_role",
+           "set_process_role"]
+
+# the pid that imported this module — the parent of any later fork.
+# A forked worker (prefill pool, DataLoader) inherits module state but
+# has a NEW pid; path derivation compares against this so the child
+# never dumps over the parent's file.
+_IMPORT_PID = os.getpid()
+
+_role_override: Optional[str] = None
+
+env_str("MXTPU_TELEMETRY_PROCESS", "",
+        "Role label of THIS process in the distributed telemetry "
+        "surfaces (flight records, per-process trace files, the "
+        "federated /metrics `process` label). Default: pid<pid>.")
+
+
+def set_process_role(role: str) -> None:
+    """Programmatic override of ``MXTPU_TELEMETRY_PROCESS`` (a serve
+    worker naming itself after its pool role)."""
+    global _role_override
+    _role_override = str(role) if role else None
+
+
+def process_role() -> str:
+    """This process's role label — env/override read PER CALL, pid
+    fallback derived per call, so a fork can never freeze the parent's
+    identity into the child."""
+    if _role_override:
+        return _role_override
+    return (os.environ.get("MXTPU_TELEMETRY_PROCESS", "")
+            or f"pid{os.getpid()}")
 
 
 def default_flight_path() -> str:
     """Where a crash dump lands: ``MXTPU_TELEMETRY_FLIGHT_PATH`` or a
     per-pid file under the system temp dir (predictable enough to find
-    after a preemption, collision-free across ranks on one host)."""
-    return env_str(
+    after a preemption, collision-free across ranks on one host).
+    Derived at DUMP time: a process forked after import gets the env
+    path suffixed with its own pid — without that, every worker in a
+    forked pool would atomic-replace the same file and the last
+    (least interesting) dump would win."""
+    path = env_str(
         "MXTPU_TELEMETRY_FLIGHT_PATH", "",
         "Flight-recorder crash-dump file; default "
-        "<tmpdir>/mxtpu_flight_<pid>.jsonl.") or os.path.join(
+        "<tmpdir>/mxtpu_flight_<pid>.jsonl. A process forked after "
+        "import dumps to <path>.<pid> so parallel dumps never "
+        "clobber.")
+    if not path:
+        return os.path.join(
             tempfile.gettempdir(), f"mxtpu_flight_{os.getpid()}.jsonl")
+    if os.getpid() != _IMPORT_PID:
+        return f"{path}.{os.getpid()}"
+    return path
 
 
 class FlightRecorder:
@@ -55,7 +97,10 @@ class FlightRecorder:
             maxlen=max(1, maxlen))
 
     def record(self, kind: str, name: str, **fields: Any) -> None:
-        evt = {"t": round(time.time(), 6), "kind": kind, "name": name}
+        # tagged with the process role so stitched/collected dumps
+        # from a multi-process serve tier stay attributable
+        evt = {"t": round(time.time(), 6), "kind": kind, "name": name,
+               "process": process_role()}
         evt.update(fields)
         with self._lock:
             self._events.append(evt)
@@ -106,7 +151,7 @@ class FlightRecorder:
         lines = []
         for e in events:
             extra = {k: v for k, v in e.items()
-                     if k not in ("t", "kind", "name")}
+                     if k not in ("t", "kind", "name", "process")}
             ts = time.strftime("%H:%M:%S", time.localtime(e["t"]))
             lines.append(f"{ts}  {e['kind']:<9} {e['name']}"
                          + (f"  {extra}" if extra else ""))

@@ -27,6 +27,8 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "interval_percentile", "interval_over_fraction",
+           "escape_label_value",
            "LATENCY_MS_BUCKETS", "BYTES_BUCKETS", "SECONDS_BUCKETS"]
 
 # log-spaced defaults: ~1.6x per step keeps the interpolation error of
@@ -39,6 +41,77 @@ LATENCY_MS_BUCKETS = tuple(
 BYTES_BUCKETS = tuple(4 ** i for i in range(2, 16))        # 16B .. 1GB
 SECONDS_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 180.0)
+
+
+def interval_percentile(bounds, prev_counts: Optional[List[int]],
+                        counts: List[int],
+                        q: float = 99.0) -> Optional[float]:
+    """Percentile of the observations that landed BETWEEN two
+    cumulative-bucket snapshots (the same interpolation as
+    :meth:`Histogram.percentile`, applied to the diff) — THE
+    bucket-diff math every windowed consumer shares (the autoscaler's
+    latency signal, the gateway's SLO gauges). ``None`` when there is
+    no previous snapshot or the window is empty."""
+    if prev_counts is None:
+        return None
+    d = [c - p for c, p in zip(counts, prev_counts)]
+    total = sum(d)
+    if total <= 0:
+        return None
+    target = q / 100.0 * total
+    cum = 0.0
+    upper = bounds[-1]
+    for i, c in enumerate(d):
+        if c == 0:
+            continue
+        lower = bounds[i - 1] if i > 0 else 0.0
+        upper = bounds[i] if i < len(bounds) else bounds[-1]
+        if cum + c >= target:
+            frac = (target - cum) / c
+            return lower + frac * (upper - lower)
+        cum += c
+    return upper
+
+
+def interval_over_fraction(bounds, prev_counts: Optional[List[int]],
+                           counts: List[int],
+                           threshold: float) -> Optional[float]:
+    """Fraction of the window's observations above ``threshold``
+    (linear interpolation inside the crossing bucket; the +Inf tail
+    counts fully once its lower edge is reached) — the violation rate
+    an SLO burn-rate gauge divides by its error budget. ``None`` when
+    the window is empty."""
+    if prev_counts is None:
+        return None
+    d = [c - p for c, p in zip(counts, prev_counts)]
+    total = sum(d)
+    if total <= 0:
+        return None
+    over = 0.0
+    for i, c in enumerate(d):
+        if c == 0:
+            continue
+        lower = bounds[i - 1] if i > 0 else 0.0
+        upper = bounds[i] if i < len(bounds) else None   # +Inf
+        if lower >= threshold:
+            over += c
+        elif upper is None:
+            over += c          # tail straddles: no width to interpolate
+        elif upper > threshold:
+            over += c * (upper - threshold) / (upper - lower)
+    return over / total
+
+
+def escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline) — exposition-grammar safety for caller-supplied labels
+    (error strings, peer addresses)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(s: str) -> str:
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 class Counter:
@@ -182,6 +255,18 @@ class Histogram:
             cum += c
         return upper                                  # numeric slack
 
+    def interval_percentile(self, prev_counts: Optional[List[int]],
+                            counts: Optional[List[int]] = None,
+                            q: float = 99.0) -> Optional[float]:
+        """Windowed percentile between two cumulative snapshots of
+        THIS histogram (``counts=None`` snapshots now — callers that
+        keep the window state pass the counts they stored). Delegates
+        to the module-level :func:`interval_percentile` so the
+        bucket-diff math exists exactly once."""
+        if counts is None:
+            counts, _, _ = self.snapshot()
+        return interval_percentile(self.bounds, prev_counts, counts, q)
+
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * len(self._counts)
@@ -288,11 +373,35 @@ class MetricsRegistry:
             for child in list(fam.children.values()):
                 child.reset()
 
+    def snapshot_state(self) -> list:
+        """A wire-safe structural dump — what a worker/replica process
+        ships to the federating gateway over the framed RPC (values,
+        not text: the merge stays exact instead of re-parsing floats).
+        ``[(name, kind, help, [(labels, payload), ...]), ...]`` where
+        ``labels`` is ``[(k, v), ...]`` and ``payload`` is a float
+        (counter/gauge) or ``(bounds, counts, sum)`` (histogram)."""
+        out = []
+        for fam in self.families():
+            with self._lock:
+                children = list(fam.children.items())
+            kids = []
+            for key, child in sorted(children):
+                labels = [(k, v) for k, v in key]
+                if fam.kind == "histogram":
+                    counts, total_sum, _ = child.snapshot()
+                    kids.append((labels, (list(child.bounds),
+                                          list(counts),
+                                          float(total_sum))))
+                else:
+                    kids.append((labels, float(child.value)))
+            out.append((fam.name, fam.kind, fam.help, kids))
+        return out
+
     # -- exporters --------------------------------------------------------
     @staticmethod
     def _fmt_labels(key: Tuple[Tuple[str, str], ...],
                     extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in key]
+        parts = [f'{k}="{escape_label_value(v)}"' for k, v in key]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
@@ -307,7 +416,8 @@ class MetricsRegistry:
         for fam in self.families():
             full = f"{self.prefix}_{fam.name}"
             if fam.help:
-                lines.append(f"# HELP {full} {fam.help}")
+                lines.append(f"# HELP {full} "
+                             f"{_escape_help(fam.help)}")
             lines.append(f"# TYPE {full} {fam.kind}")
             with self._lock:
                 children = list(fam.children.items())

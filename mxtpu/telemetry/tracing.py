@@ -29,9 +29,11 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
 from ..base import env_int, env_str
+from .flight import process_role
 
 __all__ = ["span", "instant", "trace_events", "dump_trace",
-           "clear_trace", "Span"]
+           "clear_trace", "Span", "set_context_provider",
+           "stream_path"]
 
 _MAX_EVENTS = env_int(
     "MXTPU_TELEMETRY_TRACE_EVENTS", 100_000,
@@ -43,25 +45,74 @@ _tls = threading.local()
 _stream_file = None
 _stream_failed = False
 
+# the distributed-tracing hook (telemetry.distributed installs it):
+# called per recorded event; a non-empty return (trace_id, span id,
+# request baggage) is merged under the event's args, so every span a
+# request's context is active for carries the request's trace identity
+# without tracing depending on the context layer
+_ctx_provider = None
+
+
+def set_context_provider(fn) -> None:
+    """Install the callable that supplies the CURRENT request-scoped
+    trace fields (``None``/falsy = no active context). One provider
+    per process; ``telemetry.distributed`` owns it."""
+    global _ctx_provider
+    _ctx_provider = fn
+
 
 def _now_us() -> int:
     return time.perf_counter_ns() // 1000
 
 
-# register the knob once; the per-event check below is a bare dict
+# register the knobs once; the per-event check below is a bare dict
 # lookup (this runs on every recorded event, under the trace lock)
 env_str("MXTPU_TELEMETRY_TRACE_PATH", "",
         "Stream span trace events to this file as JSONL "
         "(chrome://tracing-compatible); empty disables streaming.")
+env_str("MXTPU_TELEMETRY_TRACE_DIR", "",
+        "Stream span trace events to a PER-PROCESS JSONL file "
+        "mxtpu_trace_<role>_<pid>.jsonl under this directory — the "
+        "multi-process serving topology's form of "
+        "MXTPU_TELEMETRY_TRACE_PATH (one file per process, so a "
+        "forked worker never clobbers its parent's stream; "
+        "tools/diagnose.py timeline stitches them).")
+
+
+# derived-path cache: (dir, role, pid) -> joined path. The env/role
+# inputs are still read per call (tests and operators flip them
+# live), but the join+format — the actual cost on the per-event path
+# under the trace lock — reruns only when an input changes (fork,
+# set_process_role, a new dir).
+_derived_path: tuple = ("", "", 0, "")
+
+
+def stream_path() -> str:
+    """Where this process streams trace events right now (empty =
+    streaming off). Inputs are read at WRITE time, so a process
+    forked after import gets its own file instead of inheriting the
+    parent's."""
+    path = os.environ.get("MXTPU_TELEMETRY_TRACE_PATH", "")
+    if path:
+        return path
+    d = os.environ.get("MXTPU_TELEMETRY_TRACE_DIR", "")
+    if not d:
+        return ""
+    global _derived_path
+    role, pid = process_role(), os.getpid()
+    if _derived_path[:3] != (d, role, pid):
+        _derived_path = (d, role, pid, os.path.join(
+            d, f"mxtpu_trace_{role}_{pid}.jsonl"))
+    return _derived_path[3]
 
 
 def _stream(event: Dict[str, Any]) -> None:
-    """Append one event to MXTPU_TELEMETRY_TRACE_PATH (lock held). A
-    failing stream path degrades to in-memory-only, once, loudly."""
+    """Append one event to the stream target (lock held). A failing
+    stream path degrades to in-memory-only, once, loudly."""
     global _stream_file, _stream_failed
     if _stream_failed:
         return
-    path = os.environ.get("MXTPU_TELEMETRY_TRACE_PATH", "")
+    path = stream_path()
     if not path:
         return
     try:
@@ -82,6 +133,13 @@ def _stream(event: Dict[str, Any]) -> None:
 
 
 def _record(event: Dict[str, Any]) -> None:
+    if _ctx_provider is not None:
+        ctx_fields = _ctx_provider()
+        if ctx_fields:
+            # explicit per-event args win over context baggage
+            args = event.get("args")
+            event["args"] = ({**ctx_fields, **args} if args
+                             else dict(ctx_fields))
     with _lock:
         _events.append(event)
         _stream(event)

@@ -24,7 +24,16 @@ trusted):
 
 Everything is deterministic: the ``chaos_serve`` CI stage reruns this
 file under tools/flakiness_checker.py to prove it.
+
+ISSUE 8 adds the distributed-tracing contracts on top: a request that
+survives a replica kill keeps its ONE trace_id across the crash, the
+``gateway.redispatch`` span links the old and new replica, the KV
+handoff frames carry a versioned context header old decoders still
+accept, and ``tools/diagnose.py timeline`` stitches the per-process
+trace streams into valid chrome-trace JSON.
 """
+import json
+import os
 import threading
 import time
 
@@ -501,6 +510,236 @@ def test_disagg_chaos_stream_bit_identical_over_tcp(cfg, params):
         assert plan.injected["kv_corrupt"] == 1
         assert reg.value("gateway_kv_reconnects_total") - rc0 >= 1
         assert reg.value("gateway_prefill_restarts_total") - w0 >= 1
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: distributed request tracing through a crash
+# ---------------------------------------------------------------------------
+def _trace_events_for(trace_dir, trace_id):
+    evts = []
+    for f in sorted(os.listdir(trace_dir)):
+        if not f.endswith(".jsonl"):
+            continue
+        for line in open(os.path.join(trace_dir, f)):
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if (e.get("args") or {}).get("trace_id") == trace_id:
+                evts.append(e)
+    return evts
+
+
+def test_replica_kill_keeps_trace_id_and_redispatch_span(
+        cfg, params, tmp_path, monkeypatch):
+    """THE tracing acceptance (satellite + tentpole): a request whose
+    replica is chaos-killed mid-decode resumes on another replica
+    under the SAME trace_id; the seam is an explicit
+    ``gateway.redispatch`` span naming the old and new replica; both
+    replicas' per-request events carry the trace; and ``diagnose
+    timeline`` stitches it all into valid chrome-trace JSON."""
+    monkeypatch.setenv("MXTPU_TELEMETRY_TRACE_DIR", str(tmp_path))
+    reg = telemetry.registry()
+    rd0 = reg.value("gateway_redispatch_total")
+    gw = Gateway(lambda: _engine(cfg, params, max_slots=1),
+                 n_replicas=2, queue_max=16, supervisor_opts=SUP)
+    plan = attach_serve(gw, ServeChaosPlan(
+        seed=11, kill_replica={0: 2}))
+    try:
+        port = gw.start_http(port=0)
+        prompt = np.arange(6) % cfg.vocab_size
+        cli = GatewayClient("127.0.0.1", port)
+        rec = cli.generate(prompt, 8, seed=5, temperature=0.8)
+        assert rec["status"] == 200 and rec["reason"] == "complete"
+        assert rec["tokens"] == _reference(cfg, params, prompt, 8,
+                                           seed=5, temperature=0.8)
+        assert plan.injected["replica_kill"] >= 1
+        assert reg.value("gateway_redispatch_total") - rd0 >= 1
+        # the HTTP trailer names the trace; every event carries it
+        trace_id = rec["trace_id"]
+        assert isinstance(trace_id, str) and len(trace_id) >= 8
+        evts = _trace_events_for(str(tmp_path), trace_id)
+        names = {e["name"] for e in evts}
+        assert "gateway.submit" in names
+        assert "serve.done" in names
+        # the crash seam: one redispatch span, old AND new replica
+        rd = [e for e in evts if e["name"] == "gateway.redispatch"]
+        assert rd and rd[0]["ph"] == "X"
+        assert rd[0]["args"]["old_replica"] == "r0"
+        assert rd[0]["args"]["new_replica"] not in (None, "r0")
+        # per-request engine events on BOTH banks, one trace
+        roles = {e["args"].get("role") for e in evts
+                 if e["name"] == "serve.seat"}
+        assert len(roles) >= 2, roles
+        # stitched timeline is a valid chrome-trace JSON array
+        from tools.diagnose import timeline
+        out = str(tmp_path / "timeline.json")
+        path, mine = timeline(trace_id, trace_dir=str(tmp_path),
+                              out=out)
+        assert path == out
+        loaded = json.load(open(path))
+        assert loaded and all(
+            "name" in e and "ph" in e and "pid" in e for e in loaded)
+        assert all("ts" in e and "tid" in e for e in loaded
+                   if e["ph"] != "M")
+        assert any(e["name"] == "gateway.redispatch"
+                   for e in loaded)
+        tids = {e["args"]["trace_id"] for e in loaded
+                if e["ph"] != "M"}
+        assert tids == {trace_id}
+        # the rid baggage resolves the same timeline without the id
+        rid = rd[0]["args"]["rid"]
+        path2, mine2 = timeline(rid, trace_dir=str(tmp_path),
+                                out=str(tmp_path / "t2.json"))
+        assert path2 and len(mine2) == len(mine)
+    finally:
+        gw.close()
+
+
+def test_disagg_trace_spans_every_hop(cfg, params, tmp_path,
+                                      monkeypatch):
+    """Disagg topology: ONE trace covers front door, the prefill
+    worker's compute span, the KV handoff receive, and the decode
+    seat — and the handoff frame on the wire carries the versioned
+    context header."""
+    monkeypatch.setenv("MXTPU_TELEMETRY_TRACE_DIR", str(tmp_path))
+    be = DisaggBackend(cfg, params, n_prefill=1, n_decode=1,
+                       max_slots=2, max_len=32, min_bucket=4)
+    gw = Gateway(backend=be, queue_max=16, supervisor_opts=SUP)
+    try:
+        prompt = np.arange(5) % cfg.vocab_size
+        h = gw.submit(prompt, 4, seed=6, temperature=0.9)
+        toks = h.result(timeout=120)
+        assert h.reason == "complete"
+        assert list(toks) == _reference(cfg, params, prompt, 4,
+                                        seed=6, temperature=0.9)
+        evts = _trace_events_for(str(tmp_path), h.trace_id)
+        names = {e["name"] for e in evts}
+        assert {"gateway.submit", "gateway.prefill",
+                "gateway.handoff_recv", "serve.seat",
+                "serve.done"} <= names, names
+        pre = [e for e in evts if e["name"] == "gateway.prefill"]
+        assert pre[0]["args"]["worker"].startswith("p")
+    finally:
+        gw.close()
+
+
+def test_disagg_replica_kill_one_timeline_acceptance(
+        cfg, params, tmp_path, monkeypatch):
+    """THE ISSUE-8 acceptance scenario verbatim: disagg mode, a
+    decode replica killed mid-decode — ONE trace_id spanning the
+    front door, the prefill worker, BOTH decode replicas and the
+    re-dispatch, stitched into one valid chrome-trace timeline, with
+    tokens bit-identical to the fault-free run."""
+    monkeypatch.setenv("MXTPU_TELEMETRY_TRACE_DIR", str(tmp_path))
+    be = DisaggBackend(cfg, params, n_prefill=1, n_decode=2,
+                       max_slots=1, max_len=32, min_bucket=4)
+    gw = Gateway(backend=be, queue_max=32, supervisor_opts=SUP)
+    plan = attach_serve(gw, ServeChaosPlan(
+        seed=13, kill_replica={0: 2}))   # decode r0 dies mid-decode
+    try:
+        port = gw.start_http(port=0)
+        prompt = np.arange(6) % cfg.vocab_size
+        cli = GatewayClient("127.0.0.1", port)
+        rec = cli.generate(prompt, 8, seed=4, temperature=0.8)
+        assert rec["status"] == 200 and rec["reason"] == "complete"
+        assert rec["tokens"] == _reference(cfg, params, prompt, 8,
+                                           seed=4, temperature=0.8)
+        assert plan.injected["replica_kill"] >= 1
+        trace_id = rec["trace_id"]
+        evts = _trace_events_for(str(tmp_path), trace_id)
+        names = {e["name"] for e in evts}
+        # every hop of the request's life, one trace
+        assert {"gateway.submit", "gateway.prefill",
+                "gateway.handoff_recv", "serve.seat",
+                "gateway.redispatch", "serve.done"} <= names, names
+        roles = {e["args"].get("role") for e in evts
+                 if e["name"] == "serve.seat"}
+        assert {"r0", "r1"} <= roles, roles    # both decode banks
+        rd = [e for e in evts if e["name"] == "gateway.redispatch"]
+        assert rd and rd[0]["args"]["trace_id"] == trace_id
+        from tools.diagnose import timeline
+        path, mine = timeline(trace_id, trace_dir=str(tmp_path),
+                              out=str(tmp_path / "acc.json"))
+        loaded = json.load(open(path))
+        assert {e["name"] for e in loaded} >= names
+        assert all("ts" in e and "tid" in e for e in loaded
+                   if e["ph"] != "M")
+    finally:
+        gw.close()
+
+
+def test_kv_frame_context_header_is_versioned():
+    """The wire-compat satellite: a pre-ISSUE-8 frame (no header)
+    splits to itself and still decodes as a handoff; a wrapped frame
+    round-trips its context through the rpc codec; an UNKNOWN header
+    version keeps the payload usable and only drops the context."""
+    from mxtpu.serve.gateway.disagg import (handoff_to_wire,
+                                            wire_to_handoff)
+    from mxtpu.serve.engine import KVHandoff
+    block = np.arange(24, dtype=np.float32).reshape(1, 2, 6, 2)
+    h = KVHandoff(k=block, v=block * 2, true_len=5, token=42,
+                  rng=np.asarray([1, 2], np.uint32))
+    old_frame = handoff_to_wire(3, h)
+    # old frame: pass-through, no context
+    payload, ctx = rpc.split_context(old_frame)
+    assert payload is old_frame and ctx is None
+    rid, h2 = wire_to_handoff(payload)
+    assert rid == 3 and h2.token == 42
+    # new frame: context survives the full encode/decode round trip
+    tctx = telemetry.distributed.mint(rid=3, seed=7,
+                                      deadline_abs=12.5)
+    wrapped = rpc.attach_context(old_frame, tctx.to_wire())
+    wire = rpc.decode(bytes(rpc.encode(wrapped)))
+    payload, ctx = rpc.split_context(wire)
+    got = telemetry.TraceContext.from_wire(ctx)
+    assert got.trace_id == tctx.trace_id and got.rid == 3
+    assert got.seed == 7 and got.deadline_abs == 12.5
+    rid, h3 = wire_to_handoff(payload)
+    assert rid == 3
+    np.testing.assert_array_equal(h3.k, block)
+    # future version: payload usable, context dropped — never an error
+    future = (rpc.CTX_TAG, rpc.CTX_VERSION + 1,
+              tctx.to_wire() + ("new-field",), old_frame)
+    payload, ctx = rpc.split_context(
+        rpc.decode(bytes(rpc.encode(future))))
+    assert ctx is None
+    assert wire_to_handoff(payload)[0] == 3
+
+
+def test_slo_burn_rate_degrades_healthz(cfg, params, monkeypatch):
+    """The derived-SLO satellite: with a (deliberately impossible)
+    TTFT target configured, one served request pushes the burn rate
+    over threshold and /healthz flips to degraded with the slo block
+    populated; the SLO gauges land in the registry."""
+    monkeypatch.setenv("MXTPU_GATEWAY_SLO_TTFT_MS", "0.0001")
+    # wide window: the explicit force-ticks below advance it, while
+    # the /healthz and /metrics paths inside the window REUSE the
+    # last computed burn instead of consuming a fresh (empty) window
+    monkeypatch.setenv("MXTPU_GATEWAY_SLO_WINDOW_S", "600")
+    gw = Gateway(lambda: _engine(cfg, params), n_replicas=1,
+                 queue_max=16, supervise=False)
+    try:
+        assert gw.slo is not None
+        gw.slo.tick(force=True)              # baseline window
+        h = gw.submit(np.arange(4) % cfg.vocab_size, 2, seed=0)
+        h.result(timeout=60)
+        snap = gw.slo.tick(force=True)
+        assert snap["ttft"]["burn"] is not None
+        assert snap["ttft"]["burn"] > 1.0
+        hz = gw.health()
+        assert hz["status"] == "degraded"
+        assert hz["slo"]["breached"] is True
+        assert hz["slo"]["slos"]["ttft"]["target_ms"] == \
+            pytest.approx(0.0001)
+        reg = telemetry.registry()
+        assert reg.value("gateway_slo_burn_rate", slo="ttft") > 1.0
+        assert reg.value("gateway_slo_target_ms", slo="ttft") == \
+            pytest.approx(0.0001)
+        # scrape path ticks + renders without error
+        assert "gateway_slo_burn_rate" in gw.metrics_text()
     finally:
         gw.close()
 

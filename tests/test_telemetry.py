@@ -284,6 +284,169 @@ def test_preemption_leaves_flight_dump_on_disk(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 8: metrics federation + exposition grammar
+# ---------------------------------------------------------------------------
+def test_federated_merge_counter_exact_and_grammar():
+    """The federation acceptance: per-process series carry `process`
+    labels, the aggregate counter equals the SUM of every process's
+    value exactly, histogram buckets merge element-wise, gauges are
+    last-write — and the whole multi-process scrape parses under
+    strict Prometheus text grammar."""
+    from mxtpu.telemetry import distributed as dt
+    local = tm.MetricsRegistry()
+    r_worker = tm.MetricsRegistry()
+    r_kv = tm.MetricsRegistry()
+    for reg, n in ((local, 2.0), (r_worker, 3.5), (r_kv, 7.0)):
+        reg.counter("fed_requests_total", "requests",
+                    code="ok").inc(n)
+        reg.gauge("fed_depth", "queue depth").set(n)
+        h = reg.histogram("fed_ms", "latency", buckets=(1, 10, 100))
+        h.observe(0.5)
+        h.observe(n * 10)
+    srv1 = tm.RegistryServer(port=0, registry=r_worker,
+                             process="worker0")
+    srv2 = tm.RegistryServer(port=0, registry=r_kv, process="kvstore")
+    try:
+        text = dt.federate_text(
+            local, [("127.0.0.1", srv1.port),
+                    ("127.0.0.1", srv2.port)], process="gateway")
+        parsed = tm.parse_prometheus(text)       # strict: raises on
+        #                                          any malformed line
+        s = parsed["samples"]
+        lab = (("code", "ok"),)
+        per_proc = [s[("mxtpu_fed_requests_total",
+                       tuple(sorted(lab + (("process", p),))))]
+                    for p in ("gateway", "worker0", "kvstore")]
+        assert per_proc == [2.0, 3.5, 7.0]
+        # counter exactness: aggregate == sum of per-process
+        assert s[("mxtpu_fed_requests_total", lab)] == sum(per_proc)
+        # histogram: merged count == total observations everywhere
+        assert s[("mxtpu_fed_ms_count", ())] == 6.0
+        assert s[("mxtpu_fed_ms_bucket", (("le", "1.0"),))] == 3.0
+        # gauge: last write in scrape order (local, worker0, kvstore)
+        assert s[("mxtpu_fed_depth", ())] == 7.0
+        assert parsed["types"]["mxtpu_fed_requests_total"] == \
+            "counter"
+        assert parsed["types"]["mxtpu_fed_ms"] == "histogram"
+        # ≥ 3 distinct process labels federated in one scrape
+        procs = {dict(labels).get("process")
+                 for (_, labels) in s if dict(labels).get("process")}
+        assert {"gateway", "worker0", "kvstore"} <= procs
+    finally:
+        srv1.close()
+        srv2.close()
+
+
+def test_federation_skips_dead_peer_and_counts():
+    """A peer that is down mid-restart must cost its series, not the
+    scrape: the merged text still renders + parses, and the failure
+    is counted per peer."""
+    from mxtpu.telemetry import distributed as dt
+    local = tm.MetricsRegistry()
+    local.counter("fed_alone_total").inc(4)
+    before = tm.registry().value("federation_errors_total",
+                                 peer="127.0.0.1:1")
+    text = dt.federate_text(local, [("127.0.0.1", 1)],
+                            process="gateway", timeout=0.5)
+    parsed = tm.parse_prometheus(text)
+    assert parsed["samples"][("mxtpu_fed_alone_total", ())] == 4.0
+    assert tm.registry().value("federation_errors_total",
+                               peer="127.0.0.1:1") - before == 1
+
+
+def test_federation_dedups_colliding_process_roles():
+    """Two peers that claim the same role must not produce duplicate
+    series (a real Prometheus server rejects the whole scrape on
+    one): the second gets a deterministic positional suffix, and the
+    strict parser — which now raises on duplicates — stays happy."""
+    from mxtpu.telemetry import distributed as dt
+    local = tm.MetricsRegistry()
+    r1, r2 = tm.MetricsRegistry(), tm.MetricsRegistry()
+    local.counter("fed_dup_total").inc(1)
+    r1.counter("fed_dup_total").inc(2)
+    r2.counter("fed_dup_total").inc(4)
+    s1 = tm.RegistryServer(port=0, registry=r1, process="prefill")
+    s2 = tm.RegistryServer(port=0, registry=r2, process="prefill")
+    try:
+        text = dt.federate_text(
+            local, [("127.0.0.1", s1.port), ("127.0.0.1", s2.port)],
+            process="gateway")
+        parsed = tm.parse_prometheus(text)   # raises on duplicates
+        s = parsed["samples"]
+        assert s[("mxtpu_fed_dup_total", ())] == 7.0
+        assert s[("mxtpu_fed_dup_total",
+                  (("process", "prefill"),))] == 2.0
+        assert s[("mxtpu_fed_dup_total",
+                  (("process", "prefill~1"),))] == 4.0
+    finally:
+        s1.close()
+        s2.close()
+
+
+def test_prometheus_label_escaping_round_trips():
+    """Exposition polish satellite: label values with quotes,
+    backslashes and newlines must render escaped — the strict parser
+    recovers the original bytes."""
+    nasty = 'a"b\\c\nd'
+    tm.counter("t_escape_total", "counts", err=nasty).inc(3)
+    text = tm.prometheus()
+    parsed = tm.parse_prometheus(text)
+    assert parsed["samples"][("mxtpu_t_escape_total",
+                              (("err", nasty),))] == 3.0
+    assert parsed["types"]["mxtpu_t_escape_total"] == "counter"
+
+
+def test_histogram_interval_percentile_shared_helper():
+    """The bucket-diff math is one shared helper: the Histogram
+    method, the autoscaler alias and the module function agree."""
+    from mxtpu.serve.gateway.autoscale import interval_p99
+    h = tm.Histogram(buckets=(1, 2, 4, 8))
+    prev, _, _ = h.snapshot()
+    for v in (3, 3, 3, 7):
+        h.observe(v)
+    cur, _, _ = h.snapshot()
+    via_method = h.interval_percentile(list(prev), q=99.0)
+    via_fn = tm.interval_percentile(h.bounds, list(prev), list(cur),
+                                    99.0)
+    via_alias = interval_p99(h.bounds, list(prev), list(cur))
+    assert via_method == via_fn == via_alias
+    assert 4 < via_method <= 8          # p99 sits in the (4, 8] bucket
+    assert h.interval_percentile(list(cur)) is None   # empty window
+    # the burn-rate ingredient: fraction of the window over threshold
+    from mxtpu.telemetry.registry import interval_over_fraction
+    d_prev, d_cur = list(prev), list(cur)
+    frac = interval_over_fraction(h.bounds, d_prev, d_cur, 4.0)
+    assert frac == pytest.approx(0.25)  # 1 of 4 observations past 4
+    assert interval_over_fraction(h.bounds, None, d_cur, 4.0) is None
+
+
+def test_flight_fork_path_and_process_tag(tmp_path, monkeypatch):
+    """Forked-worker satellite: a process forked after import must not
+    clobber the parent's flight dump — the env path gains a .<pid>
+    suffix in the child — and every record is tagged with the process
+    role."""
+    import importlib
+    fl = importlib.import_module("mxtpu.telemetry.flight")
+    dump = tmp_path / "flight.jsonl"
+    monkeypatch.setenv("MXTPU_TELEMETRY_FLIGHT_PATH", str(dump))
+    # parent (the importing pid): exact env path, back-compat
+    assert fl.default_flight_path() == str(dump)
+    # simulated fork: same module state, different pid
+    monkeypatch.setattr(fl, "_IMPORT_PID", os.getpid() + 1)
+    child_path = fl.default_flight_path()
+    assert child_path == f"{dump}.{os.getpid()}"
+    monkeypatch.setattr(fl, "_IMPORT_PID", os.getpid())
+    # records carry the role; role honors the env override per call
+    fr = tm.FlightRecorder(maxlen=4)
+    fr.record("note", "before")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PROCESS", "prefill0")
+    fr.record("note", "after")
+    tail = fr.tail(2)
+    assert tail[0]["process"] == f"pid{os.getpid()}"
+    assert tail[1]["process"] == "prefill0"
+
+
+# ---------------------------------------------------------------------------
 # kvstore fault counters count real injected faults
 # ---------------------------------------------------------------------------
 def test_ps_fault_counters_under_chaos():

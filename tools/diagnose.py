@@ -7,7 +7,14 @@ summary table and the flight-recorder tail — importable as
 where "what was this job doing" is answered by the last N recorded
 events. Standalone invocation also tails any on-disk flight dump left
 by a preempted/crashed process (``MXTPU_TELEMETRY_FLIGHT_PATH``).
+
+``python tools/diagnose.py timeline <rid-or-trace-id>`` stitches the
+PER-PROCESS trace JSONL files of a distributed serving run
+(``MXTPU_TELEMETRY_TRACE_DIR``) into ONE chrome://tracing-loadable
+JSON file for that request — front door, prefill worker, every decode
+replica it touched, and any crash re-dispatch seam, on one timeline.
 """
+import glob as _glob
 import json
 import os
 import platform
@@ -66,6 +73,15 @@ def gateway_state(addr: str = ""):
         if r.get("error"):
             line += f" error={r['error']}"
         print(line)
+    slo = health.get("slo")
+    if slo:
+        for name, v in sorted((slo.get("slos") or {}).items()):
+            burn = v.get("burn")
+            print(f"slo {name}: p99={v.get('p99_ms')}ms "
+                  f"target={v.get('target_ms')}ms "
+                  f"burn={'n/a' if burn is None else round(burn, 2)}"
+                  + (" BREACHED" if burn is not None and
+                     burn > slo.get("burn_threshold", 1.0) else ""))
     breaker = state.get("breaker")
     if breaker:
         print(f"breaker: {breaker['state']} "
@@ -90,6 +106,136 @@ def gateway_state(addr: str = ""):
                   f"pressure={d['pressure']} p99={d['p99_ms']}")
 
 
+def _trace_files(trace_dir=None, paths=None):
+    """The trace JSONL inputs: explicit paths, a directory of
+    per-process streams, or whatever the env knobs point at."""
+    out = list(paths or [])
+    d = trace_dir or os.environ.get("MXTPU_TELEMETRY_TRACE_DIR", "")
+    if d:
+        out += sorted(_glob.glob(os.path.join(d, "*.jsonl")))
+    p = os.environ.get("MXTPU_TELEMETRY_TRACE_PATH", "")
+    if p and os.path.exists(p):
+        out.append(p)
+    # stable de-dup
+    seen, files = set(), []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            files.append(f)
+    return files
+
+
+def _load_events(files):
+    events = []
+    for f in files:
+        role = None
+        base = os.path.basename(f)
+        if base.startswith("mxtpu_trace_"):
+            # mxtpu_trace_<role>_<pid>.jsonl — role may itself
+            # contain underscores; the pid is the last segment
+            parts = base[len("mxtpu_trace_"):-len(".jsonl")] \
+                .rsplit("_", 1)
+            role = parts[0] or None
+        try:
+            with open(f) as fh:
+                for line in fh:
+                    try:
+                        evt = json.loads(line)
+                    except ValueError:
+                        continue          # torn tail line mid-write
+                    if role is not None:
+                        evt.setdefault("_role", role)
+                    events.append(evt)
+        except OSError:
+            continue
+    return events
+
+
+def timeline(key, trace_dir=None, paths=None, out=None):
+    """Stitch the per-process trace streams into one chrome-trace
+    JSON file for ONE request.
+
+    ``key``: a trace id (hex) or a gateway request id (the ``rid``
+    baggage every context-tagged event carries). Returns ``(path,
+    events)`` — ``path`` is the written chrome://tracing-loadable
+    array (None when nothing matched), ``events`` the request's
+    events sorted by timestamp. The output carries ``process_name``
+    metadata per pid, so chrome's process lanes read as the serving
+    roles, not bare pids.
+
+    Clock caveat: event timestamps are CLOCK_MONOTONIC (epoch = host
+    boot), comparable across PROCESSES on one host but not across
+    hosts. Stitching files collected from several hosts still shows
+    every hop, but the relative ordering between hosts is
+    meaningless — the function detects fully-disjoint per-process
+    clock ranges and warns instead of pretending."""
+    files = _trace_files(trace_dir, paths)
+    events = _load_events(files)
+    key_s = str(key).lower()
+    trace_ids = {key_s} if any(
+        (e.get("args") or {}).get("trace_id") == key_s
+        for e in events) else set()
+    if not trace_ids:
+        try:
+            rid = int(key)
+        except (TypeError, ValueError):
+            rid = None
+        if rid is not None:
+            trace_ids = {
+                (e.get("args") or {}).get("trace_id")
+                for e in events
+                if (e.get("args") or {}).get("rid") == rid
+                and (e.get("args") or {}).get("trace_id")}
+    mine = sorted(
+        (e for e in events
+         if (e.get("args") or {}).get("trace_id") in trace_ids),
+        key=lambda e: e.get("ts", 0))
+    if not mine:
+        print(f"timeline: no events for {key!r} in "
+              f"{len(files)} trace file(s)")
+        return None, []
+    roles = {}
+    spans_per_pid = {}
+    for e in mine:
+        if e.get("pid") is not None:
+            roles.setdefault(e["pid"], e.get("_role")
+                             or f"pid{e['pid']}")
+            lo, hi = spans_per_pid.get(e["pid"], (e["ts"], e["ts"]))
+            spans_per_pid[e["pid"]] = (min(lo, e["ts"]),
+                                       max(hi, e["ts"]))
+    # monotonic clocks share an epoch per HOST, not across hosts: a
+    # request's hops overlap in real time, so per-process ts ranges
+    # separated by more than an hour mean files from different hosts
+    # were mixed — warn rather than render a silently-wrong ordering
+    ranges = sorted(spans_per_pid.values())
+    for (_, prev_hi), (lo, _) in zip(ranges, ranges[1:]):
+        if lo - prev_hi > 3600_000_000:
+            print("timeline: WARNING — per-process timestamp ranges "
+                  "are disjoint by over an hour; these trace files "
+                  "likely come from different hosts whose monotonic "
+                  "clocks are not comparable. Per-hop durations are "
+                  "valid; cross-host ordering is not.")
+            break
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": role}}
+            for pid, role in sorted(roles.items())]
+    body = meta + [{k: v for k, v in e.items() if k != "_role"}
+                   for e in mine]
+    out = out or f"mxtpu_timeline_{'_'.join(sorted(trace_ids))}.json"
+    with open(out, "w") as fh:
+        fh.write("[\n")
+        fh.write(",\n".join(json.dumps(e) for e in body))
+        fh.write("\n]\n")
+    spans = [e for e in mine if e.get("ph") == "X"]
+    names = sorted({e["name"] for e in mine})
+    print(f"timeline: {len(mine)} events ({len(spans)} spans) for "
+          f"trace {sorted(trace_ids)} across "
+          f"{len(roles)} process(es) {sorted(roles.values())}")
+    print(f"  events: {', '.join(names)}")
+    print(f"  wrote {out} (load in chrome://tracing or Perfetto)")
+    return out, mine
+
+
 def _tail_disk_dump(n: int = 20):
     """A crashed process can't answer report() — but its flight dump
     on disk can."""
@@ -109,6 +255,25 @@ def _tail_disk_dump(n: int = 20):
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "timeline":
+        args = sys.argv[2:]
+        if not args:
+            print("usage: diagnose.py timeline <rid-or-trace-id> "
+                  "[--dir DIR] [--out FILE]")
+            sys.exit(2)
+        key, trace_dir, out = args[0], None, None
+        rest = args[1:]
+        while rest:
+            flag = rest.pop(0)
+            if flag == "--dir" and rest:
+                trace_dir = rest.pop(0)
+            elif flag == "--out" and rest:
+                out = rest.pop(0)
+            else:
+                print(f"unknown timeline arg {flag!r}")
+                sys.exit(2)
+        path, _ = timeline(key, trace_dir=trace_dir, out=out)
+        sys.exit(0 if path else 1)
     print("----------Python Info----------")
     print("version:", sys.version.replace("\n", " "))
     print("platform:", platform.platform())
