@@ -205,6 +205,20 @@ chaos_serve() {
     python tools/flakiness_checker.py tests/test_serve_chaos.py -n 3
 }
 
+chaos_train() {
+    # elastic-training fault tolerance (docs/robustness.md §"Elastic
+    # training"): the seeded train-chaos suite — host kill + resume
+    # bit-identity on both train paths, dp=2 -> dp=1 cross-mesh restore
+    # with the data-position journal proven (no batch replayed or
+    # skipped), host loss with elastic shrink, straggler eviction,
+    # SIGTERM final-save, NaN-batch nonfinite skip, loss-spike rollback
+    # with a bounded budget, torn checkpoints/journals — in a fresh
+    # pytest process, then tools/flakiness_checker.py x3 to prove the
+    # chaos plans are deterministic.
+    python -m pytest tests/test_elastic.py -x -q "$@"
+    python tools/flakiness_checker.py tests/test_elastic.py -n 3
+}
+
 telemetry_smoke() {
     # the observability layer end to end in a fresh process on the
     # ENABLED-BY-DEFAULT path (docs/observability.md): metrics through
@@ -491,6 +505,7 @@ ci_all() {
     serve_smoke
     gateway_smoke
     chaos_serve
+    chaos_train
     telemetry_smoke
     opperf_coverage
     bench_gate
@@ -508,6 +523,7 @@ ci_fast() {
     serve_smoke
     gateway_smoke
     chaos_serve
+    chaos_train
     telemetry_smoke
 }
 

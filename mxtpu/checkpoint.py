@@ -14,19 +14,90 @@ callbacks + ``fit(begin_epoch=k)``; elastic recovery did not exist).
   job never loses its tail steps (docs/robustness.md).
 - ``restore()`` falls back to the previous retained step when the
   latest checkpoint is partial/corrupt (a kill can tear a step
-  directory faster than orbax's commit protocol can clean it up).
+  directory faster than orbax's commit protocol can clean it up) —
+  every fallback is a telemetry counter + flight record, never silent.
+- **Cross-mesh restore** (the elastic-training leg, ISSUE 11): a
+  checkpoint written on a dp=N mesh restores bit-identically onto a
+  dp=M mesh — pass an ``abstract_state`` built on the NEW mesh and
+  orbax's per-shard IO reshards on read. Alongside the state, a
+  step-indexed **data-position journal** (``save_journal`` /
+  ``load_journal``, manifest-committed via ``base.manifest_commit``)
+  records where the input stream stood, so an elastic resume neither
+  replays nor skips a batch; ``restore_with_journal`` scans retained
+  steps newest-first for one whose checkpoint AND journal both
+  validate.
 - The ``.params`` compatibility surface stays in mxtpu.serde /
   Block.save_parameters; this module is the functional-path manager.
 """
 from __future__ import annotations
 
+import json as _json
 import os
 import signal as _signal
+import time as _time
 import warnings
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 __all__ = ["CheckpointManager", "PreemptionGuard", "save_state",
-           "load_state"]
+           "load_state", "describe_tree_mismatch"]
+
+
+def _metrics():
+    """Checkpoint telemetry handles, created lazily so importing this
+    module never initializes the registry (and a disabled run gets
+    no-ops)."""
+    from . import telemetry
+    return {
+        "save_s": telemetry.histogram(
+            "checkpoint_save_seconds",
+            "Checkpoint save dispatch time (async mode: time to hand "
+            "the write to the background committer).",
+            buckets=telemetry.SECONDS_BUCKETS),
+        "restore_s": telemetry.histogram(
+            "checkpoint_restore_seconds",
+            "Checkpoint restore time (disk -> placed train state).",
+            buckets=telemetry.SECONDS_BUCKETS),
+        "total": lambda kind: telemetry.counter(
+            "checkpoint_total",
+            "Checkpoint operations by kind (save/restore/fallback/"
+            "journal).", kind=kind),
+    }
+
+
+def describe_tree_mismatch(expected: Any, saved: Any) -> Optional[str]:
+    """Human diagnosis of why ``saved`` cannot restore into
+    ``expected``: the FIRST mismatched key path / shape, or None when
+    the trees are structurally compatible (the failure was something
+    else). Shared by :func:`load_state` and ``Trainer.load_states`` so
+    a mismatched param tree is a one-line answer, not an orbax/
+    tree-map traceback."""
+    import jax
+    from .parallel.sharding import key_str
+
+    def _paths(tree):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return {"/".join(key_str(k) for k in path):
+                tuple(getattr(leaf, "shape", ()))
+                for path, leaf in flat}
+
+    try:
+        exp, sav = _paths(expected), _paths(saved)
+    except Exception:
+        return None
+    for name in sorted(exp):
+        if name not in sav:
+            return (f"expected key {name!r} "
+                    f"(shape {exp[name]}) is missing from the saved "
+                    "state")
+    for name in sorted(sav):
+        if name not in exp:
+            return (f"saved state has unexpected key {name!r} "
+                    f"(shape {sav[name]})")
+    for name in sorted(exp):
+        if exp[name] != sav[name]:
+            return (f"key {name!r} was saved with shape {sav[name]} "
+                    f"but the live tree expects {exp[name]}")
+    return None
 
 
 class CheckpointManager:
@@ -45,6 +116,7 @@ class CheckpointManager:
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._m = _metrics()
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save a pytree at ``step`` (no-op off the save interval
@@ -52,8 +124,14 @@ class CheckpointManager:
         completes in the background (call wait_until_finished() before
         exiting). ``force=True`` ignores the save interval — the
         preemption final-save path."""
-        return self._mgr.save(step, args=self._ocp.args.StandardSave(state),
-                              force=force)
+        t0 = _time.perf_counter()
+        saved = self._mgr.save(step,
+                               args=self._ocp.args.StandardSave(state),
+                               force=force)
+        if saved:
+            self._m["save_s"].observe(_time.perf_counter() - t0)
+            self._m["total"]("save").inc()
+        return saved
 
     def restore(self, step: Optional[int] = None,
                 abstract_state: Any = None, fallback: bool = True) -> Any:
@@ -83,20 +161,116 @@ class CheckpointManager:
                 last_err = e
                 if not fallback:
                     raise
-                warnings.warn(
-                    f"checkpoint step {s} under {self.directory} is "
-                    f"partial/corrupt ({type(e).__name__}: {e}); "
-                    "falling back to the previous retained step",
-                    RuntimeWarning)
+                self._record_fallback(s, e)
         raise RuntimeError(
             f"every retained checkpoint under {self.directory} failed "
             f"to restore (steps {candidates})") from last_err
 
+    def _record_fallback(self, step: int, err: BaseException,
+                         what: str = "checkpoint") -> None:
+        """A torn/corrupt latest step being skipped is an EVENT, not a
+        silent branch: counter + flight record + warning, so a fleet
+        restoring one step further back than expected is diagnosable
+        from the scrape and the black box."""
+        self._m["total"]("fallback").inc()
+        try:
+            from . import telemetry
+            if telemetry.enabled():
+                telemetry.flight().record(
+                    "checkpoint", "fallback", step=int(step), what=what,
+                    directory=self.directory,
+                    error=f"{type(err).__name__}: {err}")
+        except Exception:
+            pass
+        warnings.warn(
+            f"{what} step {step} under {self.directory} is "
+            f"partial/corrupt ({type(err).__name__}: {err}); "
+            "falling back to the previous retained step",
+            RuntimeWarning)
+
     def _restore_one(self, step: int, abstract_state: Any) -> Any:
+        t0 = _time.perf_counter()
         if abstract_state is not None:
-            return self._mgr.restore(
+            out = self._mgr.restore(
                 step, args=self._ocp.args.StandardRestore(abstract_state))
-        return self._mgr.restore(step)
+        else:
+            out = self._mgr.restore(step)
+        self._m["restore_s"].observe(_time.perf_counter() - t0)
+        self._m["total"]("restore").inc()
+        return out
+
+    # -- data-position journal (elastic resume: no batch replayed or
+    # skipped — docs/robustness.md §"Elastic training") ----------------
+    def journal_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"journal_{int(step)}.mxj")
+
+    def save_journal(self, step: int, journal: dict) -> str:
+        """Manifest-commit the data-position journal for ``step`` —
+        a small JSON dict (batch cursor, per-host positions, rng
+        state...) saved ALONGSIDE the checkpoint so a resume knows
+        exactly where the input stream stood. Journals for steps no
+        longer retained are pruned. Returns the journal path."""
+        from .base import manifest_commit
+        path = self.journal_path(step)
+        manifest_commit(path, _json.dumps(
+            dict(journal, step=int(step))).encode())
+        self._m["total"]("journal").inc()
+        keep = set(self._mgr.all_steps()) | {int(step)}
+        for name in os.listdir(self.directory):
+            if name.startswith("journal_") and name.endswith(".mxj"):
+                try:
+                    s = int(name[len("journal_"):-len(".mxj")])
+                except ValueError:
+                    continue
+                if s not in keep:
+                    for p in (os.path.join(self.directory, name),
+                              os.path.join(self.directory,
+                                           name + ".payload")):
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            pass
+        return path
+
+    def load_journal(self, step: int) -> dict:
+        """Read + validate the journal for ``step``
+        (:class:`mxtpu.base.ManifestError` on a torn commit)."""
+        from .base import manifest_read
+        return _json.loads(manifest_read(self.journal_path(step)))
+
+    def restore_with_journal(self, abstract_state: Any = None
+                             ) -> Tuple[Any, dict, int]:
+        """The elastic-resume entry point: scan retained steps
+        newest-first for one whose checkpoint AND data-position
+        journal BOTH validate, and return ``(state, journal, step)``.
+        A step with a torn checkpoint or a torn/missing journal is
+        skipped (counted + flight-recorded) — resuming training state
+        without knowing the data position would silently replay or
+        skip batches, which is exactly the bug the journal exists to
+        kill."""
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        last_err: Optional[BaseException] = None
+        for s in candidates:
+            try:
+                journal = self.load_journal(s)
+            except Exception as e:
+                last_err = e
+                self._record_fallback(s, e, what="journal")
+                continue
+            try:
+                state = self._restore_one(s, abstract_state)
+            except Exception as e:
+                last_err = e
+                self._record_fallback(s, e)
+                continue
+            return state, journal, s
+        raise RuntimeError(
+            f"every retained checkpoint under {self.directory} failed "
+            f"to restore with a valid journal (steps {candidates})"
+        ) from last_err
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -202,11 +376,44 @@ def save_state(path: str, state: Any) -> None:
 
 
 def load_state(path: str, abstract_state: Any = None) -> Any:
+    """One-shot pytree load. A saved tree that does not match
+    ``abstract_state`` raises a clear :class:`mxtpu.base.MXNetError`
+    naming the FIRST mismatched key/shape — not a raw orbax/tree-map
+    traceback (the saved tree is re-read structurally to produce the
+    diagnosis)."""
     import orbax.checkpoint as ocp
+    from .base import MXNetError
     ckptr = ocp.StandardCheckpointer()
     try:
         if abstract_state is not None:
-            return ckptr.restore(os.path.abspath(path), abstract_state)
+            # validate structure BEFORE restoring: orbax silently
+            # reshapes a saved array into a differently-shaped template
+            # (observed: (3,2) saved -> (4,2) template restores without
+            # error), which would hand back corrupt parameters
+            try:
+                saved_meta = ckptr.metadata(os.path.abspath(path))
+            except Exception:
+                saved_meta = None
+            if saved_meta is not None:
+                why = describe_tree_mismatch(abstract_state, saved_meta)
+                if why is not None:
+                    raise MXNetError(
+                        f"checkpoint at {path!r} does not match the "
+                        f"provided state tree: {why}")
+            try:
+                return ckptr.restore(os.path.abspath(path),
+                                     abstract_state)
+            except Exception as e:
+                try:
+                    saved = ckptr.restore(os.path.abspath(path))
+                except Exception:
+                    raise e from None      # not a tree mismatch
+                why = describe_tree_mismatch(abstract_state, saved)
+                if why is None:
+                    raise
+                raise MXNetError(
+                    f"checkpoint at {path!r} does not match the "
+                    f"provided state tree: {why}") from e
         return ckptr.restore(os.path.abspath(path))
     finally:
         ckptr.close()

@@ -53,7 +53,9 @@ from typing import Callable, Dict, List, Optional
 
 __all__ = ["ChaosPlan", "attach", "ServerProcess", "VirtualAllreduceKV",
            "poison_nan", "simulate_preemption",
-           "ServeChaosFault", "ServeChaosPlan", "attach_serve"]
+           "ServeChaosFault", "ServeChaosPlan", "attach_serve",
+           "TrainChaosFault", "TrainChaosPlan", "SimTrainHost",
+           "attach_train"]
 
 
 class ChaosPlan:
@@ -508,6 +510,211 @@ def attach_serve(gateway, plan: ServeChaosPlan) -> ServeChaosPlan:
                     f"chaos plan targets prefill worker {idx}; pool "
                     f"has {len(workers)}")
             plan._wrap_worker(workers[idx], job)
+    return plan
+
+
+class TrainChaosFault(RuntimeError):
+    """The injected failure :class:`TrainChaosPlan` raises inside the
+    elastic train loop — a simulated host death escaping
+    ``ElasticTrainer.run``, so the test relaunches a fresh driver
+    exactly like a real crash would."""
+
+
+class SimTrainHost:
+    """A simulated PEER host in the elastic control plane: a real
+    :class:`~mxtpu.parallel.elastic.ElasticMember` over real TCP, with
+    three failure knobs —
+
+    - :meth:`kill` — stop heartbeating WITHOUT a goodbye (the kill -9
+      / eviction case; the coordinator declares it lost after
+      ``MXTPU_ELASTIC_LOST_AFTER_S``);
+    - :meth:`leave` — graceful SIGTERM-drain departure;
+    - :meth:`freeze` — keep heartbeating but stop advancing the
+      reported step (the slow host the straggler detector evicts).
+
+    A watcher thread auto-rejoins on resize notices (a live fleet's
+    survivors all re-rendezvous; without this the barrier would wait
+    on the simulated peer forever). ``advance(step)`` mirrors the
+    driver's progress so the sim host keeps pace in normal times."""
+
+    def __init__(self, host_id: str, address, heartbeat_s=None,
+                 secret=None):
+        from ..parallel.elastic import ElasticMember
+        self.host_id = host_id
+        self._member = ElasticMember(host_id, address,
+                                     heartbeat_s=heartbeat_s,
+                                     secret=secret)
+        self._frozen = False
+        self._stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+
+    def join(self) -> int:
+        gen = self._member.join()
+        if self._watcher is None:
+            self._watcher = threading.Thread(
+                target=self._watch, daemon=True,
+                name=f"sim-host:{self.host_id}")
+            self._watcher.start()
+        return gen
+
+    def _watch(self) -> None:
+        from ..parallel.elastic import ElasticError
+        while not self._stop.wait(0.05):
+            if self._member.resize_pending.is_set():
+                try:
+                    self._member.rejoin()
+                except (ElasticError, ConnectionError, OSError):
+                    pass
+
+    def advance(self, step: int) -> None:
+        if not self._frozen:
+            self._member.report_step(step)
+
+    def freeze(self) -> None:
+        self._frozen = True
+
+    def kill(self) -> None:
+        """Silent death: heartbeats stop, no leave message."""
+        self._stop.set()
+        self._member._stop.set()
+
+    def leave(self) -> None:
+        self._stop.set()
+        self._member.leave()
+
+    @property
+    def generation(self) -> int:
+        return self._member.generation
+
+
+class TrainChaosPlan:
+    """Seeded, schedule-driven fault injection for ELASTIC TRAINING
+    (the train-side sibling of :class:`ServeChaosPlan`; docs/
+    robustness.md §"Elastic training"). Attach to a live
+    ``ElasticTrainer`` with :func:`attach_train`; every action fires at
+    a deterministic step, so a chaos run is exactly reproducible (the
+    ``chaos_train`` CI stage proves it under flakiness_checker):
+
+    - ``kill_at`` — step N: THIS process's training loop dies (a
+      :class:`TrainChaosFault` escaping ``run()``); the test relaunches
+      a fresh driver, which must resume from the last committed
+      checkpoint+journal bit-identically.
+    - ``sigterm_at`` — step N: deliver SIGTERM to this process (the
+      scheduler preemption notice ``PreemptionGuard`` absorbs →
+      final synchronous save + clean return).
+    - ``kill_host_at`` — {host_id: step}: a simulated PEER host goes
+      silent → coordinator eviction → generation bump → the driver
+      resizes and resumes at the new world size.
+    - ``slow_host_at`` — {host_id: step}: the peer freezes its step
+      progress → straggler detection → same resize path.
+    - ``nan_at`` — steps whose batch is NaN-poisoned (drives the
+      in-program nonfinite skip / rollback guard).
+    - ``torn_checkpoint_at`` — step N: after the save at step N
+      commits, every file in its step directory is overwritten with
+      garbage (a kill mid-write torn worse than orbax's commit
+      protocol can clean) — restore must fall back to the previous
+      retained step, loudly.
+
+    ``injected`` counts what actually fired, for test assertions."""
+
+    def __init__(self, seed: int = 0,
+                 kill_at: Optional[int] = None,
+                 sigterm_at: Optional[int] = None,
+                 kill_host_at: Optional[Dict[str, int]] = None,
+                 slow_host_at: Optional[Dict[str, int]] = None,
+                 nan_at: Optional[List[int]] = None,
+                 torn_checkpoint_at: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self.kill_at = kill_at
+        self.sigterm_at = sigterm_at
+        self.kill_host_at = dict(kill_host_at or {})
+        self.slow_host_at = dict(slow_host_at or {})
+        self.nan_at = set(nan_at or ())
+        self.torn_checkpoint_at = torn_checkpoint_at
+        self.injected: Dict[str, int] = {
+            "kill": 0, "sigterm": 0, "host_kill": 0, "host_slow": 0,
+            "nan": 0, "torn_checkpoint": 0}
+
+    @property
+    def total_injected(self) -> int:
+        return sum(self.injected.values())
+
+    def _poison_batch(self, batch):
+        """Replace every array leaf of the batch with NaNs of the same
+        shape/dtype (works for the functional path's pytree batch and
+        the fused path's tuple-of-arrays batch alike)."""
+        import jax
+        import jax.numpy as jnp
+
+        def nanlike(x):
+            a = jnp.asarray(getattr(x, "_data", x))
+            if not jnp.issubdtype(a.dtype, jnp.floating):
+                return x
+            return jnp.full(a.shape, jnp.nan, dtype=a.dtype)
+
+        return jax.tree.map(nanlike, batch)
+
+    def _tear_step_dir(self, step: int, directory: str) -> None:
+        d = os.path.join(directory, str(int(step)))
+        for root, _, files in os.walk(d):
+            for name in files:
+                try:
+                    with open(os.path.join(root, name), "wb") as f:
+                        f.write(b"torn by chaos")
+                except OSError:
+                    pass
+        self.injected["torn_checkpoint"] += 1
+
+
+def attach_train(trainer, plan: TrainChaosPlan,
+                 hosts: Optional[Dict[str, SimTrainHost]] = None
+                 ) -> TrainChaosPlan:
+    """Wire a :class:`TrainChaosPlan` into a live
+    ``ElasticTrainer`` (its ``pre_step_hooks``/``post_save_hooks``)
+    and the simulated peer ``hosts`` the host-level faults target."""
+    hosts = dict(hosts or {})
+    for hid in list(plan.kill_host_at) + list(plan.slow_host_at):
+        if hid not in hosts:
+            raise ValueError(
+                f"chaos plan targets host {hid!r} but no such "
+                f"SimTrainHost was passed (have {sorted(hosts)})")
+
+    def pre_step(i, batch):
+        for hid, at in list(plan.kill_host_at.items()):
+            if i >= at:
+                plan.injected["host_kill"] += 1
+                del plan.kill_host_at[hid]
+                hosts[hid].kill()
+        for hid, at in list(plan.slow_host_at.items()):
+            if i >= at:
+                plan.injected["host_slow"] += 1
+                del plan.slow_host_at[hid]
+                hosts[hid].freeze()
+        for h in hosts.values():
+            h.advance(i)
+        if plan.sigterm_at is not None and i >= plan.sigterm_at:
+            plan.sigterm_at = None
+            plan.injected["sigterm"] += 1
+            simulate_preemption()
+        if plan.kill_at is not None and i >= plan.kill_at:
+            plan.kill_at = None
+            plan.injected["kill"] += 1
+            raise TrainChaosFault(f"chaos: train host killed at "
+                                  f"step {i}")
+        if i in plan.nan_at:
+            plan.injected["nan"] += 1
+            return plan._poison_batch(batch)
+        return batch
+
+    def post_save(step, directory):
+        if plan.torn_checkpoint_at is not None and \
+                step == plan.torn_checkpoint_at:
+            plan.torn_checkpoint_at = None
+            trainer.manager.wait_until_finished()
+            plan._tear_step_dir(step, directory)
+
+    trainer.pre_step_hooks.append(pre_step)
+    trainer.post_save_hooks.append(post_save)
     return plan
 
 

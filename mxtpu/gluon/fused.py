@@ -618,6 +618,105 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
             scaler._unskipped = new_amp["unskipped"]
         return NDArray(loss)
 
+    def state_dict():
+        """EVERYTHING a bit-identical resume needs, as one pytree of
+        device arrays + int32 scalars: params (live AND frozen, by
+        name), per-param optimizer state, the dynamic-AMP box (scale /
+        clean-step / applied-step), and the HOST update counters
+        (``optimizer.num_update`` + per-index counts) that feed
+        Adam-family bias correction — forgetting those would silently
+        restart bias correction at t=0. The tree structure is FIXED
+        for a given net+optimizer, so a freshly-built program's
+        state_dict doubles as the abstract template for
+        :meth:`mxtpu.checkpoint.CheckpointManager.restore` — including
+        onto a DIFFERENT mesh shape (cross-mesh restore: orbax re-reads
+        per-shard, the template's shardings place the result)."""
+        counts = jnp.asarray(
+            [optimizer._index_update_count.get(i, 0)
+             for i in range(len(live))], jnp.int32)
+        sd = {"params": {p.name: p.data()._data for p in params},
+              "opt": {p.name: s for p, s in zip(live, opt_states)
+                      if s is not None},
+              "counters": {
+                  "num_update": jnp.asarray(optimizer.num_update,
+                                            jnp.int32),
+                  "index_update_count": counts}}
+        if dynamic_amp:
+            sd["amp"] = dict(box["amp"])
+        return sd
+
+    def load_state_dict(sd):
+        """Inverse of :func:`state_dict`: write a (possibly
+        checkpoint-restored, possibly other-mesh-shaped) state tree
+        back into the live Parameters, opt states, AMP box, and host
+        counters. Arrays are re-placed on THIS program's shardings, so
+        a tree restored onto a different mesh lands correctly. A
+        missing/mis-shaped entry raises :class:`MXNetError` naming the
+        parameter."""
+        from .. import autograd
+        from ..parallel.sharding import global_device_put
+        import numpy as _onp
+        with autograd.pause():
+            for p in params:
+                if p.name not in sd.get("params", {}):
+                    raise MXNetError(
+                        f"fused state_dict has no parameter "
+                        f"'{p.name}' — wrong checkpoint for this net?")
+                v = jnp.asarray(sd["params"][p.name])
+                cur = p.data()._data
+                if v.shape != cur.shape:
+                    raise MXNetError(
+                        f"restored parameter '{p.name}' has shape "
+                        f"{v.shape} but the net expects {cur.shape}")
+                p._data._set_data(
+                    global_device_put(v.astype(cur.dtype),
+                                      shardings[p.name]))
+        new_states = []
+        for p, s in zip(live, opt_states):
+            if s is None:
+                new_states.append(None)
+                continue
+            saved = sd.get("opt", {}).get(p.name)
+            if saved is None:
+                raise MXNetError(
+                    f"fused state_dict has no optimizer state for "
+                    f"'{p.name}'")
+            cur_leaves, treedef = jax.tree_util.tree_flatten(s)
+            sv_leaves = jax.tree_util.tree_leaves(saved)
+            if len(sv_leaves) != len(cur_leaves):
+                raise MXNetError(
+                    f"optimizer state for '{p.name}' has "
+                    f"{len(sv_leaves)} leaves, expected "
+                    f"{len(cur_leaves)}")
+            placed = []
+            for cv, sv in zip(cur_leaves, sv_leaves):
+                sv = jnp.asarray(sv)
+                if sv.shape != cv.shape:
+                    raise MXNetError(
+                        f"optimizer state for '{p.name}' has leaf "
+                        f"shape {sv.shape}, expected {cv.shape}")
+                placed.append(global_device_put(sv.astype(cv.dtype),
+                                                shardings[p.name]))
+            new_states.append(treedef.unflatten(placed))
+        opt_states[:] = new_states
+        counters = sd["counters"]
+        optimizer.num_update = int(counters["num_update"])
+        optimizer._index_update_count = {
+            i: int(c) for i, c in
+            enumerate(_onp.asarray(counters["index_update_count"]))}
+        if dynamic_amp:
+            a = sd.get("amp", {})
+            box["amp"] = {
+                "scale": _gput(jnp.asarray(a["scale"], jnp.float32),
+                               repl),
+                "unskipped": _gput(jnp.asarray(a["unskipped"],
+                                               jnp.int32), repl),
+                "t": _gput(jnp.asarray(a["t"], jnp.int32), repl)}
+            scaler.loss_scale = box["amp"]["scale"]
+            scaler._unskipped = box["amp"]["unskipped"]
+
+    step.state_dict = state_dict
+    step.load_state_dict = load_state_dict
     step.num_compiles = lambda: (box["past_compiles"] +
                                  int(box["jitted"]._cache_size()))
     step.loss_scale = (lambda: float(box["amp"]["scale"])) \

@@ -265,5 +265,49 @@ class Trainer:
             dump_optimizer=False))
 
     def load_states(self, fname: str) -> None:
+        """Load optimizer states saved by :meth:`save_states`,
+        validating them against THIS Trainer's parameters first: a
+        states blob from a different model (unknown parameter index,
+        or a state leaf whose shape disagrees with the parameter it
+        belongs to) raises :class:`MXNetError` naming the first
+        mismatched key/shape instead of corrupting the updater."""
+        import pickle
         with open(fname, "rb") as f:
-            self._updaters[0].set_states(f.read())
+            blob = f.read()
+        obj = pickle.loads(blob)
+        states = obj[0] if (isinstance(obj, tuple) and len(obj) == 2
+                            and isinstance(obj[1], opt.Optimizer)) else obj
+        if not isinstance(states, dict):
+            raise MXNetError(
+                f"{fname!r} is not a Trainer states file "
+                f"(expected a dict of per-parameter states, got "
+                f"{type(states).__name__})")
+        self._validate_states(states)
+        self._updaters[0].set_states(blob)
+
+    def _validate_states(self, states: Dict) -> None:
+        def leaves(st):
+            if isinstance(st, (tuple, list)):
+                for s in st:
+                    yield from leaves(s)
+            elif st is not None and hasattr(st, "shape"):
+                yield st
+        for idx in sorted(states, key=repr):
+            if not isinstance(idx, int) or \
+                    not 0 <= idx < len(self._params):
+                raise MXNetError(
+                    f"optimizer states name parameter index {idx!r} "
+                    f"which this Trainer does not have "
+                    f"({len(self._params)} params)")
+            p = self._params[idx]
+            if p._data is None:
+                continue   # uninitialized — shape unknown yet
+            pshape = tuple(p.data().shape)
+            for leaf in leaves(states[idx]):
+                lshape = tuple(leaf.shape)
+                if lshape != pshape:
+                    raise MXNetError(
+                        f"optimizer state for parameter '{p.name}' "
+                        f"(index {idx}) has shape {lshape} but the "
+                        f"parameter has shape {pshape} — the saved "
+                        "states do not match this Trainer's params")

@@ -38,8 +38,10 @@ Fault tolerance (docs/robustness.md):
   a timeout instead of blocking forever, and each reconnect is
   verified with a heartbeat ping before the request is replayed.
 - With ``MXTPU_PS_SNAPSHOT_PATH`` set, the server snapshots its store
-  + updater + dedup state to disk (atomic tmp+rename via
-  ``base.atomic_write``) every ``MXTPU_PS_SNAPSHOT_EVERY`` mutations
+  + updater + dedup state to disk (manifest-committed via
+  ``base.manifest_commit`` — atomic payload + size/sha256 manifest,
+  the same discipline ``CheckpointManager``'s data-position journal
+  uses) every ``MXTPU_PS_SNAPSHOT_EVERY`` mutations
   (and/or every ``MXTPU_PS_SNAPSHOT_INTERVAL`` seconds) and reloads it
   on restart — workers retry through the outage and training continues
   through a kill+restart. The dedup table rides in the same snapshot
@@ -79,7 +81,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as onp
 
 from .. import rpc, telemetry
-from ..base import (MXNetError, atomic_write, env_float, env_int, env_str)
+from ..base import (MXNetError, env_float, env_int, env_str)
 
 __all__ = ["KVStoreServer", "ServerClient", "server_address",
            "PSAuthError", "PSProtocolError"]
@@ -222,13 +224,14 @@ class KVStoreServer:
         if not path or not os.path.exists(path):
             return
         try:
-            with open(path, "rb") as f:
-                blob = pickle.load(f)
+            from ..base import manifest_read
+            blob = pickle.loads(manifest_read(path))
             self._store = blob["store"]
             self._updaters = blob["updaters"]
             self._sessions = blob.get("sessions", {})
         except Exception as e:
-            # atomic_write means a torn file should be impossible; an
+            # manifest_commit validates size+sha256 end to end, so a
+            # torn payload is DETECTED here rather than half-loaded; an
             # unreadable snapshot (version skew, manual edit) must not
             # brick the server — start empty and say so
             import warnings
@@ -247,7 +250,8 @@ class KVStoreServer:
                              "updaters": self._updaters,
                              "sessions": self._sessions},
                             protocol=pickle.HIGHEST_PROTOCOL)
-        atomic_write(self._snap_path, blob)
+        from ..base import manifest_commit
+        manifest_commit(self._snap_path, blob)
         self._m_snap.observe(time.perf_counter() - t0)
         telemetry.flight().record("ps", "snapshot", bytes=len(blob))
         self._mutations_since_snap = 0

@@ -13,9 +13,14 @@ from .sharding import (P, ShardingRules, batch_spec, constrain, named,
 from .collectives import (allgather, allreduce, alltoall, axis_index,
                           barrier_sync, pmean, ppermute_ring, reduce_scatter)
 from .step import TrainState, init_state, make_eval_step, make_train_step
+from .elastic import (ElasticCoordinator, ElasticError, ElasticMember,
+                      ElasticTrainer, FusedProgram, JournaledData,
+                      StepProgram)
 from . import dist
 
 __all__ = [
+    "ElasticCoordinator", "ElasticError", "ElasticMember",
+    "ElasticTrainer", "FusedProgram", "JournaledData", "StepProgram",
     "MESH_AXES", "MeshConfig", "axis_size", "create_mesh", "current_mesh",
     "mesh_axes", "use_mesh",
     "P", "ShardingRules", "batch_spec", "constrain", "named", "replicated",

@@ -1,0 +1,830 @@
+"""Elastic training (ISSUE 11): the preemption-tolerant driver for the
+mesh train paths — ``make_train_step`` (functional) and
+``Trainer.make_fused_step`` (Gluon).
+
+The reference's whole recovery story was checkpoint+restart on an
+IDENTICAL cluster (``checkpoint.py`` header: "elastic recovery did not
+exist"). This module closes that gap with four cooperating layers, in
+the spirit of Bamboo/Varuna-style elastic resizing and CheckFreq-style
+overlapped checkpointing:
+
+- :class:`ElasticCoordinator` / :class:`ElasticMember` — a lightweight
+  multi-host **rendezvous + heartbeat** control plane on the framed RPC
+  protocol (``rpc.FramedServer``, HMAC, ``connect_with_backoff``). Hosts
+  ``join`` (a barrier that seals a *generation* once everyone expected
+  has arrived), then heartbeat their step progress. A host that stops
+  beating (kill -9, eviction), leaves (SIGTERM drain), or sustainedly
+  lags the pack (**straggler detection** — the PR 7 replica-supervisor
+  idea lifted to train) is evicted: the generation bumps, survivors see
+  the bump on their next beat, re-rendezvous, and resume at the new
+  world size.
+- :class:`JournaledData` — a deterministic ``batch_index -> batch``
+  stream with an explicit cursor. Because the GLOBAL batch is constant
+  across world sizes, the training trajectory is mesh-shape-independent
+  and the cursor is the only data state a resume needs. The cursor is
+  manifest-committed alongside every checkpoint
+  (``CheckpointManager.save_journal``) so a resume — same mesh or
+  cross-mesh — neither replays nor skips a batch.
+- :class:`StepProgram` / :class:`FusedProgram` — one program protocol
+  (``train_step`` / ``state_dict`` / ``load_state_dict``) over both
+  train paths, so the driver is path-agnostic. A fresh program's
+  ``state_dict`` doubles as the orbax restore template, which is what
+  makes **cross-mesh restore** work: build the program on the NEW mesh,
+  restore the dp=N checkpoint into its dp=M-placed template, and orbax's
+  per-shard IO reshards on read, bit-identically.
+- :class:`ElasticTrainer` — the run loop tying it together: restore
+  (checkpoint+journal) -> train -> save, with **step-level anomaly
+  guards**: the in-program nonfinite skip (``make_train_step(...,
+  skip_nonfinite=True)`` — the AMP overflow-skip generalized to non-AMP
+  training) counted host-side, plus a loss-spike detector (median of a
+  trailing window) with BOUNDED rollback-to-last-checkpoint. Every
+  decision is a telemetry counter or flight record, and a goodput gauge
+  (useful steps / wall second) makes the cost of every fault visible.
+
+Single-process CI note: the coordinator/member layer is real TCP (the
+same bytes a multi-host fleet would exchange) but in tests the peers
+are simulated heartbeat clients (``contrib.chaos.SimTrainHost``) and
+the mesh is rebuilt process-locally over virtual CPU devices — the
+resize mechanics, the journal discipline, and the bit-identity oracle
+are exactly what a real fleet runs.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..base import MXNetError, env_float, env_int, env_str
+
+__all__ = ["ElasticError", "ElasticCoordinator", "ElasticMember",
+           "JournaledData", "StepProgram", "FusedProgram",
+           "ElasticTrainer"]
+
+
+class ElasticError(MXNetError):
+    """Elastic-training control-plane failure (rendezvous timeout,
+    rollback budget exhausted, coordinator unreachable)."""
+
+
+def _heartbeat_s() -> float:
+    return env_float(
+        "MXTPU_ELASTIC_HEARTBEAT_S", 0.2,
+        "Elastic training: seconds between host heartbeats to the "
+        "coordinator.")
+
+
+def _lost_after_s() -> float:
+    return env_float(
+        "MXTPU_ELASTIC_LOST_AFTER_S", 2.0,
+        "Elastic training: a host whose last heartbeat is older than "
+        "this is declared lost and evicted (generation bump).")
+
+
+def _join_timeout_s() -> float:
+    return env_float(
+        "MXTPU_ELASTIC_JOIN_TIMEOUT_S", 30.0,
+        "Elastic training: how long a join/rendezvous blocks waiting "
+        "for the generation to seal before failing.")
+
+
+def _secret() -> bytes:
+    return env_str(
+        "MXTPU_ELASTIC_SECRET", "",
+        "Shared HMAC secret for the elastic rendezvous/heartbeat "
+        "channel (empty = unauthenticated, loopback/test use).").encode()
+
+
+def _straggler_lag() -> int:
+    return env_int(
+        "MXTPU_ELASTIC_STRAGGLER_LAG", 50,
+        "Elastic training: a host this many steps behind the "
+        "fastest host is a straggler candidate.")
+
+
+def _straggler_after_s() -> float:
+    return env_float(
+        "MXTPU_ELASTIC_STRAGGLER_AFTER_S", 5.0,
+        "Elastic training: a straggler candidate sustained this long "
+        "is flight-recorded and evicted through the resize path.")
+
+
+def _metrics():
+    from .. import telemetry
+    return {
+        "gen": telemetry.gauge(
+            "elastic_generation",
+            "Current sealed elastic-training generation."),
+        "world": telemetry.gauge(
+            "elastic_world_size",
+            "Number of hosts in the sealed generation."),
+        "resizes": lambda reason: telemetry.counter(
+            "elastic_resizes_total",
+            "Elastic generation bumps by trigger.", reason=reason),
+        "stragglers": telemetry.counter(
+            "elastic_stragglers_total",
+            "Hosts evicted by the straggler detector."),
+        "host_step": lambda host: telemetry.gauge(
+            "elastic_host_step",
+            "Last step each host reported on its heartbeat.",
+            host=host),
+    }
+
+
+# ---------------------------------------------------------------------------
+# control plane: rendezvous + heartbeat + membership
+# ---------------------------------------------------------------------------
+class ElasticCoordinator:
+    """The rendezvous/heartbeat server — one per job, typically on host
+    0 (the same spot the reference kept its ps-lite scheduler). Framed
+    protocol, request/reply:
+
+    - ``("join", host_id)`` — BLOCKING rendezvous barrier: registers
+      the host and waits until the generation seals (everyone expected
+      has joined), then replies ``("ok", generation, members)``.
+      Generation 0 seals when ``n_hosts`` distinct hosts have joined;
+      after a membership change, the next generation seals when every
+      surviving member has re-joined. A NEW host joining a sealed job
+      triggers a grow-resize the same way a loss triggers a shrink.
+    - ``("beat", host_id, step)`` — heartbeat + step progress; replies
+      ``("ok", target_generation, world)``. A member whose sealed
+      generation differs from the target knows to re-rendezvous.
+    - ``("leave", host_id)`` — graceful departure (SIGTERM drain).
+    - ``("state",)`` — observability snapshot (``tools/diagnose.py
+      elastic``).
+
+    A background sweeper declares hosts lost when their heartbeat goes
+    stale (``MXTPU_ELASTIC_LOST_AFTER_S``) and evicts sustained
+    stragglers (``MXTPU_ELASTIC_STRAGGLER_LAG`` steps behind for
+    ``MXTPU_ELASTIC_STRAGGLER_AFTER_S``) — both bump the generation and
+    both are counters + flight records, never silent."""
+
+    def __init__(self, n_hosts: int, host: str = "127.0.0.1",
+                 port: int = 0, secret: Optional[bytes] = None,
+                 heartbeat_s: Optional[float] = None,
+                 lost_after_s: Optional[float] = None,
+                 straggler_lag: Optional[int] = None,
+                 straggler_after_s: Optional[float] = None):
+        from .. import rpc
+        if n_hosts < 1:
+            raise ValueError(f"n_hosts must be >= 1, got {n_hosts}")
+        self.n_hosts = n_hosts
+        self._secret = _secret() if secret is None else secret
+        self._heartbeat_s = heartbeat_s if heartbeat_s is not None \
+            else _heartbeat_s()
+        self._lost_after_s = lost_after_s if lost_after_s is not None \
+            else _lost_after_s()
+        self._straggler_lag = straggler_lag if straggler_lag is not None \
+            else _straggler_lag()
+        self._straggler_after_s = straggler_after_s \
+            if straggler_after_s is not None else _straggler_after_s()
+        self._m = _metrics()
+        self._cond = threading.Condition()
+        # host_id -> {"beat": monotonic, "step": int, "lag_since": t|None}
+        self._members: Dict[str, Dict[str, Any]] = {}
+        self._pending: set = set()       # joined since last seal
+        self._gen = -1                   # sealed generation
+        self._target_gen = 0             # generation being rendezvoused
+        self._sealed_once = False
+        self._stop = threading.Event()
+        self._server = rpc.FramedServer(self._handle, host=host,
+                                        port=port, secret=self._secret)
+        self.host, self.port = self._server.host, self._server.port
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, daemon=True,
+            name=f"elastic-sweep:{self.port}")
+        self._sweeper.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    @property
+    def generation(self) -> int:
+        with self._cond:
+            return self._gen
+
+    def members(self) -> List[str]:
+        with self._cond:
+            return sorted(self._members)
+
+    # -- wire handler ------------------------------------------------------
+    def _handle(self, msg, authed, addr):
+        if not isinstance(msg, tuple) or not msg:
+            return ("err", "malformed elastic message")
+        op = msg[0]
+        if op == "join" and len(msg) == 2:
+            return self._join(str(msg[1]))
+        if op == "beat" and len(msg) == 3:
+            return self._beat(str(msg[1]), int(msg[2]))
+        if op == "leave" and len(msg) == 2:
+            return self._leave(str(msg[1]))
+        if op == "state":
+            return self._state()
+        return ("err", f"unknown elastic op {op!r}")
+
+    def _join(self, host_id: str):
+        deadline = time.monotonic() + _join_timeout_s()
+        with self._cond:
+            first = host_id not in self._members
+            rec = self._members.setdefault(
+                host_id, {"beat": 0.0, "step": -1, "lag_since": None})
+            rec["beat"] = time.monotonic()
+            if first and self._sealed_once and \
+                    self._gen == self._target_gen:
+                # grow: a brand-new host on a sealed job forces a
+                # resize exactly like a loss does — survivors re-join
+                self._bump("join")
+            self._pending.add(host_id)
+            self._maybe_seal()
+            target = self._target_gen
+            while self._gen < target:
+                if self._target_gen != target:
+                    # another resize landed while we waited — chase it
+                    target = self._target_gen
+                    self._pending.add(host_id)
+                    self._maybe_seal()
+                if not self._cond.wait(timeout=0.05) and \
+                        time.monotonic() > deadline:
+                    return ("err", "rendezvous timed out: generation "
+                            f"{target} never sealed "
+                            f"(pending={sorted(self._pending)}, "
+                            f"members={sorted(self._members)})")
+            return ("ok", self._gen, sorted(self._members))
+
+    def _beat(self, host_id: str, step: int):
+        with self._cond:
+            rec = self._members.get(host_id)
+            if rec is None:
+                # evicted (or never joined): tell it to re-rendezvous
+                return ("rejoin", self._target_gen)
+            rec["beat"] = time.monotonic()
+            rec["step"] = max(rec["step"], step)
+            self._m["host_step"](host_id).set(rec["step"])
+            return ("ok", self._target_gen, len(self._members))
+
+    def _leave(self, host_id: str):
+        with self._cond:
+            if host_id in self._members:
+                self._evict(host_id, "leave")
+            return ("ok",)
+
+    def _state(self):
+        now = time.monotonic()
+        with self._cond:
+            rows = [(h, int(r["step"]), round(now - r["beat"], 3))
+                    for h, r in sorted(self._members.items())]
+            return ("ok", self._gen, self._target_gen,
+                    len(self._members), rows)
+
+    # -- membership machinery (call with self._cond held) ------------------
+    def _bump(self, reason: str) -> None:
+        self._target_gen += 1
+        self._pending.clear()
+        self._m["resizes"](reason).inc()
+        try:
+            from .. import telemetry
+            if telemetry.enabled():
+                telemetry.flight().record(
+                    "elastic", "resize", reason=reason,
+                    target_generation=self._target_gen,
+                    members=",".join(sorted(self._members)))
+        except Exception:
+            pass
+
+    def _evict(self, host_id: str, reason: str) -> None:
+        self._members.pop(host_id, None)
+        self._pending.discard(host_id)
+        self._bump(reason)
+        self._maybe_seal()     # survivors may all have re-joined already
+
+    def _maybe_seal(self) -> None:
+        if self._gen == self._target_gen:
+            return
+        alive = set(self._members)
+        ready = (len(self._pending) >= self.n_hosts
+                 if not self._sealed_once
+                 else bool(alive) and self._pending >= alive)
+        if ready:
+            self._gen = self._target_gen
+            self._sealed_once = True
+            self._pending.clear()
+            self._m["gen"].set(self._gen)
+            self._m["world"].set(len(self._members))
+            self._cond.notify_all()
+
+    def _sweep_loop(self) -> None:
+        period = max(0.02, min(self._heartbeat_s, self._lost_after_s / 4))
+        while not self._stop.wait(period):
+            now = time.monotonic()
+            with self._cond:
+                if not self._sealed_once:
+                    continue           # nobody committed yet — no evictions
+                top = max((r["step"] for r in self._members.values()),
+                          default=0)
+                for h, r in list(self._members.items()):
+                    if now - r["beat"] > self._lost_after_s:
+                        self._evict(h, "lost")
+                        continue
+                    if top - r["step"] >= self._straggler_lag > 0:
+                        if r["lag_since"] is None:
+                            r["lag_since"] = now
+                        elif now - r["lag_since"] >= \
+                                self._straggler_after_s:
+                            self._m["stragglers"].inc()
+                            try:
+                                from .. import telemetry
+                                if telemetry.enabled():
+                                    telemetry.flight().record(
+                                        "elastic", "straggler", host=h,
+                                        step=int(r["step"]),
+                                        top_step=int(top),
+                                        lag=int(top - r["step"]))
+                            except Exception:
+                                pass
+                            self._evict(h, "straggler")
+                    else:
+                        r["lag_since"] = None
+
+    def close(self) -> None:
+        self._stop.set()
+        self._server.close()
+
+    def __enter__(self) -> "ElasticCoordinator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ElasticMember:
+    """One host's client side of the control plane: a blocking
+    :meth:`join` rendezvous, then a daemon heartbeat thread reporting
+    step progress. When a beat reply shows the target generation moved
+    past ours (someone died, lagged, left, or arrived),
+    ``resize_pending`` is set and the driver re-rendezvouses with
+    :meth:`rejoin` at the next step boundary."""
+
+    def __init__(self, host_id: str, address: Tuple[str, int],
+                 secret: Optional[bytes] = None,
+                 heartbeat_s: Optional[float] = None):
+        self.host_id = host_id
+        self.address = tuple(address)
+        self._secret = _secret() if secret is None else secret
+        self._heartbeat_s = heartbeat_s if heartbeat_s is not None \
+            else _heartbeat_s()
+        self.generation = -1
+        self.world = 0
+        self.members: List[str] = []
+        self.step = 0
+        self.resize_pending = threading.Event()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _connect(self):
+        import socket
+        from .. import rpc
+        deadline = time.monotonic() + _join_timeout_s()
+        self._sock = rpc.connect_with_backoff(
+            lambda: socket.create_connection(self.address, timeout=5.0),
+            deadline)
+        self._sock.settimeout(_join_timeout_s() + 5.0)
+
+    def join(self) -> int:
+        """Blocking rendezvous: returns the sealed generation (and
+        populates ``world``/``members``). Starts the heartbeat thread
+        on first call."""
+        from .. import rpc
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            reply = rpc.call(self._sock, ("join", self.host_id),
+                             self._secret)
+        if not (isinstance(reply, tuple) and reply and
+                reply[0] == "ok"):
+            raise ElasticError(f"elastic join failed: {reply!r}")
+        self.generation, self.members = int(reply[1]), list(reply[2])
+        self.world = len(self.members)
+        self.resize_pending.clear()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._beat_loop, daemon=True,
+                name=f"elastic-beat:{self.host_id}")
+            self._thread.start()
+        return self.generation
+
+    def rejoin(self) -> int:
+        """Re-rendezvous after a resize notice — same barrier, new
+        generation/world."""
+        return self.join()
+
+    def report_step(self, step: int) -> None:
+        self.step = int(step)
+
+    def _beat_loop(self) -> None:
+        from .. import rpc
+        while not self._stop.wait(self._heartbeat_s):
+            try:
+                with self._lock:
+                    if self._sock is None:
+                        self._connect()
+                    reply = rpc.call(
+                        self._sock, ("beat", self.host_id,
+                                     int(self.step)), self._secret)
+            except (ConnectionError, OSError):
+                # coordinator restarting / network blip: drop the
+                # socket, reconnect on the next beat
+                with self._lock:
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                continue
+            if isinstance(reply, tuple) and reply:
+                if reply[0] == "rejoin" or (
+                        reply[0] == "ok" and
+                        int(reply[1]) != self.generation):
+                    self.resize_pending.set()
+
+    def leave(self) -> None:
+        """Graceful departure (the SIGTERM-drain path): stop beating,
+        tell the coordinator, close."""
+        from .. import rpc
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    rpc.call(self._sock, ("leave", self.host_id),
+                             self._secret)
+                except (ConnectionError, OSError):
+                    pass
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def close(self) -> None:
+        self.leave()
+
+
+# ---------------------------------------------------------------------------
+# deterministic, journaled input stream
+# ---------------------------------------------------------------------------
+class JournaledData:
+    """A deterministic ``batch_index -> batch`` stream with an explicit
+    cursor — the data half of elastic resume.
+
+    ``batch_fn(i)`` must be a PURE function of the index (seeded
+    generator, deterministic shard reader...) producing the GLOBAL
+    batch, identical at any world size — that invariance is what makes
+    the training trajectory mesh-shape-independent, so a dp=2
+    checkpoint resumed on dp=1 continues the exact same sequence. The
+    cursor rides the data-position journal
+    (:meth:`mxtpu.checkpoint.CheckpointManager.save_journal`); restoring
+    it is what guarantees a resume neither replays nor skips a batch."""
+
+    def __init__(self, batch_fn: Callable[[int], Any], cursor: int = 0):
+        self._fn = batch_fn
+        self.cursor = int(cursor)
+
+    def next(self) -> Any:
+        batch = self._fn(self.cursor)
+        self.cursor += 1
+        return batch
+
+    def peek(self, index: Optional[int] = None) -> Any:
+        return self._fn(self.cursor if index is None else int(index))
+
+    def journal(self) -> dict:
+        return {"cursor": int(self.cursor)}
+
+    def restore(self, journal: dict) -> None:
+        self.cursor = int(journal["cursor"])
+
+
+# ---------------------------------------------------------------------------
+# the program protocol: one surface over both train paths
+# ---------------------------------------------------------------------------
+class StepProgram:
+    """Functional-path program: wraps a ``make_train_step`` step and its
+    :class:`~mxtpu.parallel.step.TrainState`.
+
+    ``step_fn(state, batch) -> (state, loss[, skipped])`` — build it
+    with ``skip_nonfinite=True`` (closing over rng if used) to get the
+    in-program nonfinite skip; the driver reads the trailing flag."""
+
+    supports_skip = True
+
+    def __init__(self, step_fn: Callable, state):
+        self._step = step_fn
+        self.state = state
+
+    def train_step(self, batch) -> Tuple[Any, Any]:
+        out = self._step(self.state, batch)
+        if len(out) == 3:
+            self.state, loss, skipped = out
+            return loss, skipped
+        self.state, loss = out
+        return loss, False
+
+    def state_dict(self):
+        return self.state
+
+    def load_state_dict(self, sd) -> None:
+        self.state = type(self.state)(*sd) \
+            if not isinstance(sd, type(self.state)) else sd
+
+    def step_count(self) -> int:
+        return int(self.state.step)
+
+
+class FusedProgram:
+    """Gluon-path program: wraps a ``Trainer.make_fused_step`` step.
+    Nonfinite handling lives either in the program (dynamic AMP's
+    overflow skip) or in the driver's rollback guard — the fused step
+    itself reports ``skipped=False`` and the driver checks the loss."""
+
+    supports_skip = False
+
+    def __init__(self, fused_step: Callable):
+        self._step = fused_step
+
+    def train_step(self, batch) -> Tuple[Any, Any]:
+        batch = batch if isinstance(batch, (tuple, list)) else (batch,)
+        return self._step(*batch), False
+
+    def state_dict(self):
+        return self._step.state_dict()
+
+    def load_state_dict(self, sd) -> None:
+        self._step.load_state_dict(sd)
+
+    def step_count(self) -> int:
+        return int(self._step.applied_updates())
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+class ElasticTrainer:
+    """The elastic run loop.
+
+    ``factory(world_size) -> program`` builds the mesh at the given
+    world size and returns a :class:`StepProgram`/:class:`FusedProgram`
+    (anything speaking the protocol). It runs once at start and again
+    after every resize — the factory owns mesh construction, so
+    shrink/grow is just "call it again with the new size".
+
+    Per step: consume one journaled batch, run the program, feed the
+    anomaly guards, heartbeat progress, checkpoint on the save
+    interval (state via ``CheckpointManager.save``, data cursor via
+    ``save_journal`` — a checkpoint without its journal never
+    restores). On ``resize_pending``: re-rendezvous, rebuild via
+    ``factory``, restore from the last committed checkpoint+journal
+    (cross-mesh restore — the template is the NEW program's
+    state_dict). On SIGTERM (:class:`~mxtpu.checkpoint
+    .PreemptionGuard`): one final synchronous save + journal, then a
+    clean return.
+
+    Anomaly guards: a program-reported nonfinite skip advances the
+    data cursor but not the model ("the step never happened", AMP
+    semantics) and increments ``train_nonfinite_skips_total``. A loss
+    above ``spike_factor``× the trailing-window median — or a
+    nonfinite loss on a program without in-program skip — triggers
+    rollback to the last checkpoint (``train_loss_spike_rollbacks
+    _total``), REPLAYING the batches since it by design; the budget is
+    ``max_rollbacks`` per run, after which :class:`ElasticError` ends
+    the run loudly (persistent divergence is a bug, not weather).
+
+    The host-side ``float(loss)`` sync that feeds the guards is the
+    one per-step device sync this loop adds; set ``spike_window=0``
+    to run guard-free and fully async."""
+
+    def __init__(self, factory: Callable[[int], Any],
+                 data: JournaledData,
+                 manager,                      # CheckpointManager
+                 member: Optional[ElasticMember] = None,
+                 save_every: int = 1,
+                 spike_factor: Optional[float] = None,
+                 spike_window: Optional[int] = None,
+                 max_rollbacks: Optional[int] = None):
+        self._factory = factory
+        self.data = data
+        self.manager = manager
+        self.member = member
+        self.save_every = max(1, int(save_every))
+        self.spike_factor = spike_factor if spike_factor is not None \
+            else env_float(
+                "MXTPU_ELASTIC_SPIKE_FACTOR", 10.0,
+                "Elastic training: a loss above this multiple of the "
+                "trailing-window median triggers rollback to the last "
+                "checkpoint.")
+        self.spike_window = spike_window if spike_window is not None \
+            else env_int(
+                "MXTPU_ELASTIC_SPIKE_WINDOW", 20,
+                "Elastic training: trailing-window length for the "
+                "loss-spike detector (0 disables host-side guards).")
+        self.max_rollbacks = max_rollbacks if max_rollbacks is not None \
+            else env_int(
+                "MXTPU_ELASTIC_MAX_ROLLBACKS", 2,
+                "Elastic training: rollback-to-checkpoint budget per "
+                "run; exceeding it raises instead of looping forever.")
+        self.program = None
+        self.generation = member.generation if member else 0
+        # chaos/observability hooks: pre_step(i, batch)->batch may
+        # raise to simulate a crash; post_save(i, directory) runs after
+        # a committed save (the torn-checkpoint injection point)
+        self.pre_step_hooks: List[Callable] = []
+        self.post_save_hooks: List[Callable] = []
+        self._stats = {"useful": 0, "skipped": 0, "replayed": 0,
+                       "rollbacks": 0, "resizes": 0, "preempted": False}
+
+    # -- internals ---------------------------------------------------------
+    def _world(self) -> int:
+        return self.member.world if self.member else 1
+
+    def _counters(self):
+        from .. import telemetry
+        return {
+            "steps": lambda kind: telemetry.counter(
+                "train_steps_total",
+                "Elastic-driver steps by kind "
+                "(useful/skipped/replayed).", kind=kind),
+            "skips": telemetry.counter(
+                "train_nonfinite_skips_total",
+                "Steps whose update was skipped for a nonfinite "
+                "loss/grad (in-program guard)."),
+            "rollbacks": telemetry.counter(
+                "train_loss_spike_rollbacks_total",
+                "Rollbacks to the last checkpoint triggered by the "
+                "loss-spike/nonfinite guard."),
+            "goodput": telemetry.gauge(
+                "train_goodput_steps_per_s",
+                "Useful (committed, non-replayed) steps per wall "
+                "second since the driver started."),
+        }
+
+    def _build(self) -> None:
+        self.program = self._factory(self._world())
+
+    def _restore(self) -> int:
+        """Restore the newest checkpoint whose journal also validates;
+        returns the step/cursor to resume from (0 = fresh start)."""
+        try:
+            state, journal, step = self.manager.restore_with_journal(
+                self.program.state_dict())
+        except FileNotFoundError:
+            return 0
+        self.program.load_state_dict(state)
+        self.data.restore(journal)
+        return int(step)
+
+    def _save(self, step: int) -> None:
+        if self.manager.save(step, self.program.state_dict()):
+            self.manager.save_journal(
+                step, dict(self.data.journal(),
+                           generation=int(self.generation)))
+            for hook in self.post_save_hooks:
+                hook(step, self.manager.directory)
+
+    def _resize(self, counters) -> int:
+        """Re-rendezvous, rebuild the program on the new world size,
+        restore from the last committed checkpoint+journal. Returns
+        the step to resume from."""
+        self.generation = self.member.rejoin()
+        self._stats["resizes"] += 1
+        try:
+            from .. import telemetry
+            if telemetry.enabled():
+                telemetry.flight().record(
+                    "elastic", "driver_resize",
+                    generation=int(self.generation),
+                    world=int(self._world()))
+        except Exception:
+            pass
+        self.manager.wait_until_finished()
+        self._build()
+        return self._restore()
+
+    def _rollback(self, counters, why: str, step: int, loss) -> int:
+        self._stats["rollbacks"] += 1
+        if self._stats["rollbacks"] > self.max_rollbacks:
+            raise ElasticError(
+                f"loss anomaly at step {step} ({why}, loss={loss}) and "
+                f"the rollback budget ({self.max_rollbacks}) is spent — "
+                "training is diverging, not unlucky")
+        counters["rollbacks"].inc()
+        try:
+            from .. import telemetry
+            if telemetry.enabled():
+                telemetry.flight().record(
+                    "train", "rollback", step=int(step), why=why,
+                    loss=float(loss))
+        except Exception:
+            pass
+        self.manager.wait_until_finished()
+        return self._restore()
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, total_steps: int, guard=None) -> dict:
+        """Train to ``total_steps`` committed steps; returns the stats
+        dict. ``guard`` is an entered
+        :class:`~mxtpu.checkpoint.PreemptionGuard` — on SIGTERM the
+        loop force-saves checkpoint+journal and returns with
+        ``preempted=True``."""
+        import math as _math
+        counters = self._counters()
+        if self.member is not None and self.member.generation < 0:
+            self.generation = self.member.join()
+        if self.program is None:
+            self._build()
+        i = self._restore()
+        window: List[float] = []
+        high_water = i
+        t0 = time.monotonic()
+        useful0 = self._stats["useful"]
+        while i < total_steps:
+            if self.member is not None and \
+                    self.member.resize_pending.is_set():
+                i = self._resize(counters)
+                window.clear()
+                continue
+            if guard is not None and guard.preempted:
+                self._save_preempted(i)
+                break
+            batch = self.data.peek()
+            for hook in self.pre_step_hooks:
+                out = hook(i, batch)
+                if out is not None:
+                    batch = out
+            self.data.cursor += 1          # consume what we ran
+            loss, skipped = self.program.train_step(batch)
+            replay = i < high_water
+            if self.spike_window > 0 or self.program.supports_skip:
+                loss_f = float(loss)
+                skipped_f = bool(skipped)
+                if skipped_f:
+                    self._stats["skipped"] += 1
+                    counters["skips"].inc()
+                    counters["steps"]("skipped").inc()
+                    # the batch is consumed but the model step never
+                    # happened — matches the AMP applied-count rule
+                    i += 1
+                    continue
+                if self.spike_window > 0:
+                    if not _math.isfinite(loss_f):
+                        i = self._rollback(counters, "nonfinite loss",
+                                           i, loss_f)
+                        window.clear()
+                        continue
+                    if len(window) >= self.spike_window:
+                        med = sorted(window)[len(window) // 2]
+                        if loss_f > self.spike_factor * max(
+                                abs(med), 1e-12):
+                            i = self._rollback(counters, "loss spike",
+                                               i, loss_f)
+                            window.clear()
+                            continue
+                    window.append(loss_f)
+                    if len(window) > self.spike_window:
+                        window.pop(0)
+            i += 1
+            if replay:
+                self._stats["replayed"] += 1
+                counters["steps"]("replayed").inc()
+            else:
+                self._stats["useful"] += 1
+                counters["steps"]("useful").inc()
+                high_water = i
+            if self.member is not None:
+                self.member.report_step(i)
+            wall = time.monotonic() - t0
+            if wall > 0:
+                counters["goodput"].set(
+                    (self._stats["useful"] - useful0) / wall)
+            if i % self.save_every == 0 or i == total_steps:
+                self._save(i)
+        self.manager.wait_until_finished()
+        return dict(self._stats, steps=i,
+                    generation=int(self.generation),
+                    world=self._world())
+
+    def _save_preempted(self, step: int) -> None:
+        self._stats["preempted"] = True
+        self.manager.wait_until_finished()
+        try:
+            self.manager.save(step, self.program.state_dict(),
+                              force=True)
+        except Exception as e:
+            if type(e).__name__ != "StepAlreadyExistsError":
+                raise
+        self.manager.save_journal(
+            step, dict(self.data.journal(),
+                       generation=int(self.generation)))
+        self.manager.wait_until_finished()

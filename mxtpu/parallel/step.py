@@ -106,7 +106,8 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
                     has_rng: bool = False,
                     grad_accum: int = 1,
                     loss_has_aux: bool = False,
-                    has_state: bool = False):
+                    has_state: bool = False,
+                    skip_nonfinite: bool = False):
     """Build the jitted sharded step.
 
     ``loss_fn(params, batch[, rng]) -> loss`` (or ``(loss, aux)`` with
@@ -116,6 +117,16 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
     stats — the reference's aux params). ``tx`` is an optax
     GradientTransformation. Returns ``step(state, batch[, rng]) ->
     (state, loss[, aux])``; ``state`` is donated.
+
+    ``skip_nonfinite=True`` generalizes the AMP dynamic-loss-scaling
+    overflow skip to plain (non-AMP) training: a step whose loss or
+    any gradient leaf is inf/nan applies NO update — params, opt
+    state, model state, and the step counter all keep their old
+    values inside the same XLA program (a ``where`` select, no host
+    round-trip), exactly the fused-step AMP semantics where a skipped
+    step "never happened". The step then returns an extra trailing
+    ``skipped`` bool scalar — ``(state, loss[, aux], skipped)`` — so
+    the driver can count skips (``train_nonfinite_skips_total``).
     """
     if has_state and loss_has_aux:
         raise ValueError("has_state already uses the aux slot for "
@@ -175,6 +186,19 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
             params, jax.tree.map(lambda s: NamedSharding(mesh, s),
                                  rules.tree_specs(params),
                                  is_leaf=lambda s: isinstance(s, P)))
+        if skip_nonfinite:
+            finite = jnp.isfinite(loss)
+            for g in jax.tree.leaves(grads):
+                finite = finite & jnp.all(jnp.isfinite(g))
+            sel = lambda new_v, old_v: jnp.where(finite, new_v, old_v)
+            params = jax.tree.map(sel, params, state.params)
+            opt_state = jax.tree.map(sel, opt_state, state.opt_state)
+            mstate = jax.tree.map(sel, mstate, state.model_state)
+            new = TrainState(params, opt_state,
+                             state.step + finite.astype(jnp.int32), mstate)
+            if loss_has_aux:
+                return new, loss, aux, ~finite
+            return new, loss, ~finite
         new = TrainState(params, opt_state, state.step + 1, mstate)
         if loss_has_aux:
             return new, loss, aux

@@ -45,7 +45,7 @@ from .base import env_int
 __all__ = ["RPCAuthError", "RPCProtocolError", "encode", "decode",
            "send_msg", "recv_msg", "max_frame_bytes", "MAC_SIZE",
            "connect_with_backoff", "attach_context", "split_context",
-           "CTX_TAG", "CTX_VERSION"]
+           "CTX_TAG", "CTX_VERSION", "FramedServer", "call"]
 
 _LEN = struct.Struct("<Q")
 _I = struct.Struct("<q")
@@ -314,6 +314,90 @@ def send_msg(sock: socket.socket, obj: Any, secret: bytes = b"") -> int:
     n = len(out) + len(mac)
     sock.sendall(_LEN.pack(n) + mac + out)
     return n
+
+
+def call(sock: socket.socket, obj: Any, secret: bytes = b"") -> Any:
+    """One request/reply roundtrip on an established framed channel —
+    the client half of :class:`FramedServer`."""
+    send_msg(sock, obj, secret)
+    msg, _ = recv_msg(sock, secret)
+    return msg
+
+
+class FramedServer:
+    """Minimal threaded request/reply server for the framed protocol:
+    one daemon thread accepts, one daemon thread per connection runs
+    ``handler(msg, authed, addr) -> reply`` per frame. Grown for the
+    elastic-training rendezvous/heartbeat control plane (small
+    messages, long-lived connections) — the kvstore server keeps its
+    own loop because its handlers touch per-connection state this
+    deliberately does not have.
+
+    A handler exception becomes an ``("err", "<Type>: <msg>")`` reply
+    instead of killing the connection; an auth/protocol failure closes
+    only the offending connection. ``port=0`` binds an ephemeral port,
+    read back from ``.port`` (the test/chaos-harness idiom)."""
+
+    def __init__(self, handler: Callable[[Any, bool, Tuple], Any],
+                 host: str = "127.0.0.1", port: int = 0,
+                 secret: bytes = b""):
+        import threading
+        self._handler = handler
+        self._secret = secret
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True,
+                                        name=f"framed-accept:{self.port}")
+        self._accept.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def _accept_loop(self) -> None:
+        import threading
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return                      # socket closed — shutdown
+            threading.Thread(target=self._serve_conn, args=(conn, addr),
+                             daemon=True,
+                             name=f"framed-conn:{addr[1]}").start()
+
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    msg, authed = recv_msg(conn, self._secret)
+                except (ConnectionError, OSError):
+                    return                  # peer gone / auth / foreign
+                try:
+                    reply = self._handler(msg, authed, addr)
+                except Exception as e:      # handler bug ≠ dead server
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                try:
+                    send_msg(conn, reply, self._secret)
+                except (ConnectionError, OSError):
+                    return
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FramedServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def recv_msg(sock: socket.socket, secret: bytes = b"",

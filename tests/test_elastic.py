@@ -1,0 +1,627 @@
+"""Elastic-training chaos suite (ISSUE 11 / docs/robustness.md
+§"Elastic training"): seeded faults against the preemption-tolerant
+mesh train loop — host kill mid-run, SIGTERM drain, host loss with
+elastic shrink, stragglers, NaN batches, loss spikes, torn checkpoints
+and torn journals.
+
+The acceptance bar everywhere is the bit-identity oracle: on the
+deterministic CPU mesh a killed-and-resumed run must be INDISTINGUISHABLE
+from a fault-free one (exact parameter equality), and the data-position
+journal must prove no batch was replayed or skipped. Everything is
+deterministic (fixed seeds, scheduled faults) — ci/runtime_functions.sh
+``chaos_train`` reruns the file under tools/flakiness_checker.py."""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import mxtpu as mx
+from mxtpu import gluon, telemetry as tm
+from mxtpu.base import ManifestError, MXNetError, manifest_commit, \
+    manifest_read
+from mxtpu.checkpoint import (CheckpointManager, PreemptionGuard,
+                              load_state, save_state)
+from mxtpu.contrib import chaos
+from mxtpu.gluon import nn
+from mxtpu.parallel import (ElasticCoordinator, ElasticError,
+                            ElasticMember, ElasticTrainer, FusedProgram,
+                            JournaledData, P, ShardingRules, StepProgram,
+                            create_mesh, init_state, make_train_step)
+
+# fast control-plane constants for tests: real multi-host deployments
+# use the MXTPU_ELASTIC_* env knobs (docs/env_var.md)
+HB = 0.03          # heartbeat period
+LOST = 0.4         # declare a silent host lost after this
+
+
+def _batch_fn(i):
+    """Deterministic batch_index -> GLOBAL batch (identical at every
+    world size — the JournaledData contract)."""
+    rng = onp.random.default_rng(1000 + i)
+    return (jnp.asarray(rng.standard_normal((8, 3)).astype(onp.float32)),
+            jnp.asarray(rng.standard_normal((8, 2)).astype(onp.float32)))
+
+
+def _make_program(world, skip_nonfinite=True):
+    """Functional-path program on a dp=world mesh over the first
+    ``world`` virtual devices."""
+    mesh = create_mesh(dp=world, devices=jax.devices()[:world])
+    rules = ShardingRules([(r".*", P())])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    tx = optax.adam(1e-2)
+    step = make_train_step(loss_fn, tx, mesh, rules,
+                           skip_nonfinite=skip_nonfinite)
+    state = init_state({"w": jnp.ones((3, 2), jnp.float32)}, tx, mesh,
+                       rules)
+    return StepProgram(step, state)
+
+
+def _assert_trees_bitwise_equal(a, b):
+    la = [onp.asarray(x) for x in jax.tree.leaves(a)]
+    lb = [onp.asarray(x) for x in jax.tree.leaves(b)]
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        onp.testing.assert_array_equal(x, y)
+
+
+def _run_reference(tmpdir, steps):
+    """Fault-free run; returns (stats, final TrainState)."""
+    mgr = CheckpointManager(str(tmpdir), async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=2, spike_window=0)
+    s = tr.run(steps)
+    mgr.close()
+    return s, tr.program.state
+
+
+# ---------------------------------------------------------------------------
+# control plane: rendezvous, heartbeat, eviction, straggler detection
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_eviction_and_rejoin():
+    """Two hosts rendezvous (generation 0 seals), one dies silently
+    (kill -9 analogue: heartbeats just stop), the sweeper evicts it,
+    the survivor sees the resize and re-rendezvouses at world 1."""
+    coord = ElasticCoordinator(2, heartbeat_s=HB, lost_after_s=LOST,
+                               straggler_lag=0)
+    try:
+        m1 = ElasticMember("h1", coord.address, heartbeat_s=HB)
+        m2 = ElasticMember("h2", coord.address, heartbeat_s=HB)
+        got = {}
+        t = threading.Thread(target=lambda: got.update(g=m1.join()))
+        t.start()
+        g2 = m2.join()
+        t.join(timeout=10)
+        assert got["g"] == g2 == 0
+        assert m1.world == m2.world == 2
+        assert m1.members == ["h1", "h2"]
+
+        m2._stop.set()                      # silent death
+        deadline = time.monotonic() + 10
+        while not m1.resize_pending.is_set() and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert m1.resize_pending.is_set(), "survivor never saw the loss"
+        g = m1.rejoin()
+        assert g >= 1 and m1.world == 1 and m1.members == ["h1"]
+
+        # observability: the state op and the Prometheus scrape both
+        # show the new generation/world
+        import socket
+        from mxtpu import rpc
+        s = socket.create_connection(coord.address)
+        reply = rpc.call(s, ("state",))
+        s.close()
+        assert reply[0] == "ok" and reply[3] == 1
+        if tm.enabled():
+            text = tm.prometheus()
+            for fam in ("mxtpu_elastic_generation",
+                        "mxtpu_elastic_world_size",
+                        "mxtpu_elastic_resizes_total"):
+                assert f"# TYPE {fam}" in text, fam
+        m1.leave()
+    finally:
+        coord.close()
+
+
+def test_straggler_detected_and_evicted():
+    """A host sustainedly lagging the pack is flight-recorded and
+    evicted through the same resize path as a lost host."""
+    coord = ElasticCoordinator(2, heartbeat_s=HB, lost_after_s=30.0,
+                               straggler_lag=5, straggler_after_s=0.15)
+    try:
+        fast = ElasticMember("fast", coord.address, heartbeat_s=HB)
+        lag = ElasticMember("lag", coord.address, heartbeat_s=HB)
+        t = threading.Thread(target=lag.join)
+        t.start()
+        fast.join()
+        t.join(timeout=10)
+        fast.report_step(100)               # lag stays at step 0
+        deadline = time.monotonic() + 10
+        while not fast.resize_pending.is_set() and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fast.resize_pending.is_set(), "straggler never evicted"
+        fast.rejoin()
+        assert fast.world == 1 and fast.members == ["fast"]
+        if tm.enabled():
+            assert "mxtpu_elastic_stragglers_total" in tm.prometheus()
+            kinds = [(r.get("kind"), r.get("name"))
+                     for r in tm.flight().tail(50)]
+            assert ("elastic", "straggler") in kinds
+        lag._stop.set()
+        fast.leave()
+    finally:
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: kill mid-run, resume, bit-identity
+# ---------------------------------------------------------------------------
+
+def test_kill_resume_bit_identity_functional(tmp_path):
+    """Functional path: a run killed at an arbitrary step and resumed
+    by a FRESH driver (new process analogue: nothing carried over but
+    the checkpoint directory) is bit-identical to fault-free."""
+    _, ref_state = _run_reference(tmp_path / "ref", 10)
+
+    d = str(tmp_path / "chaos")
+    mgr = CheckpointManager(d, async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=2, spike_window=0)
+    plan = chaos.attach_train(tr, chaos.TrainChaosPlan(kill_at=5))
+    with pytest.raises(chaos.TrainChaosFault):
+        tr.run(10)
+    assert plan.injected["kill"] == 1
+    mgr.close()
+
+    mgr2 = CheckpointManager(d, async_save=False)
+    tr2 = ElasticTrainer(lambda w: _make_program(1),
+                         JournaledData(_batch_fn), mgr2,
+                         save_every=2, spike_window=0)
+    s2 = tr2.run(10)
+    mgr2.close()
+    assert s2["steps"] == 10 and s2["replayed"] == 0
+    _assert_trees_bitwise_equal(tr2.program.state, ref_state)
+    if tm.enabled():
+        assert "# TYPE mxtpu_train_steps_total" in tm.prometheus()
+        assert "mxtpu_train_goodput_steps_per_s" in tm.prometheus()
+
+
+def _fused_trainer_program():
+    """Gluon fused path with FIXED prefixes so a relaunch rebuilds the
+    exact same parameter names (what a real relaunch of the same script
+    gets for free)."""
+    mx.random.seed(7)
+    net = nn.HybridSequential(prefix="elnet_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=12))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    mesh = create_mesh(dp=-1)
+    rules = ShardingRules([(r".*", P())])
+    net.shard(mesh, rules)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    fused = tr.make_fused_step(
+        net, loss_fn=lambda out, y: ((out - y) ** 2).mean(), loss_args=1)
+    return net, FusedProgram(fused)
+
+
+def _fused_batch_fn(i):
+    rng = onp.random.default_rng(2000 + i)
+    return (mx.nd.array(rng.standard_normal((8, 12)).astype(onp.float32)),
+            mx.nd.array(rng.standard_normal((8, 4)).astype(onp.float32)))
+
+
+def test_kill_resume_bit_identity_fused(tmp_path):
+    """Gluon path: Trainer.make_fused_step state (params + momentum +
+    update counters) survives kill+resume bit-identically on the same
+    mesh."""
+    mgr = CheckpointManager(str(tmp_path / "ref"), async_save=False)
+    net_ref, prog_ref = _fused_trainer_program()
+    tr = ElasticTrainer(lambda w: prog_ref, JournaledData(_fused_batch_fn),
+                        mgr, save_every=2, spike_window=0)
+    tr.run(8)
+    mgr.close()
+    ref = {p.name: p.data().asnumpy().copy()
+           for p in net_ref.collect_params().values()}
+
+    d = str(tmp_path / "chaos")
+    mgr = CheckpointManager(d, async_save=False)
+    _, prog = _fused_trainer_program()
+    tr = ElasticTrainer(lambda w: prog, JournaledData(_fused_batch_fn),
+                        mgr, save_every=2, spike_window=0)
+    chaos.attach_train(tr, chaos.TrainChaosPlan(kill_at=5))
+    with pytest.raises(chaos.TrainChaosFault):
+        tr.run(8)
+    mgr.close()
+
+    mgr2 = CheckpointManager(d, async_save=False)
+    net2, prog2 = _fused_trainer_program()
+    tr2 = ElasticTrainer(lambda w: prog2, JournaledData(_fused_batch_fn),
+                         mgr2, save_every=2, spike_window=0)
+    s2 = tr2.run(8)
+    mgr2.close()
+    assert s2["steps"] == 8 and prog2.step_count() == 8
+    got = {p.name: p.data().asnumpy()
+           for p in net2.collect_params().values()}
+    assert sorted(got) == sorted(ref)
+    for name in ref:
+        onp.testing.assert_array_equal(got[name], ref[name])
+
+
+# ---------------------------------------------------------------------------
+# cross-mesh restore: dp=2 checkpoint -> dp=1 mesh
+# ---------------------------------------------------------------------------
+
+def test_cross_mesh_restore_dp2_to_dp1(tmp_path):
+    """A dp=2 checkpoint restores onto a dp=1 mesh with a bit-identical
+    state tree, and the journal proves the resumed stream neither
+    replays nor skips a batch."""
+    d = str(tmp_path)
+    mgr = CheckpointManager(d, async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(2),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=3, spike_window=0)
+    tr.run(6)
+    state_dp2 = tr.program.state
+    mgr.close()
+
+    # the cross-mesh template is the NEW (dp=1) program's state_dict
+    mgr2 = CheckpointManager(d, async_save=False)
+    state, journal, step = mgr2.restore_with_journal(
+        _make_program(1).state_dict())
+    assert step == 6 and journal["cursor"] == 6
+    _assert_trees_bitwise_equal(state, state_dp2)
+
+    # resume on dp=1: the recorded batch indices must be exactly the
+    # unconsumed tail — no replay, no skip
+    consumed = []
+
+    def recording_batch_fn(i):
+        consumed.append(i)
+        return _batch_fn(i)
+
+    tr2 = ElasticTrainer(lambda w: _make_program(1),
+                         JournaledData(recording_batch_fn), mgr2,
+                         save_every=3, spike_window=0)
+    s = tr2.run(10)
+    mgr2.close()
+    assert consumed == [6, 7, 8, 9]
+    assert s["replayed"] == 0 and s["useful"] == 4
+    assert int(tr2.program.state.step) == 10
+
+
+def test_elastic_shrink_dp2_to_dp1_sim_host(tmp_path):
+    """Full elastic resize: a 2-host job loses a host mid-run; the
+    survivor re-rendezvouses, rebuilds the mesh at dp=1, restores
+    checkpoint+journal, and finishes all 30 steps."""
+    built = []
+
+    def factory(world):
+        built.append(world)
+        return _make_program(world)
+
+    coord = ElasticCoordinator(2, heartbeat_s=HB, lost_after_s=LOST,
+                               straggler_lag=0)
+    try:
+        sim = chaos.SimTrainHost("h1", coord.address, heartbeat_s=HB)
+        t = threading.Thread(target=sim.join)
+        t.start()
+        member = ElasticMember("h0", coord.address, heartbeat_s=HB)
+        member.join()
+        t.join(timeout=10)
+        assert member.world == 2
+
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        tr = ElasticTrainer(factory, JournaledData(_batch_fn), mgr,
+                            member=member, save_every=1, spike_window=0)
+        chaos.attach_train(tr, chaos.TrainChaosPlan(kill_host_at={"h1": 4}),
+                           hosts={"h1": sim})
+        # pace the loop so the eviction lands mid-run, not after it
+        tr.pre_step_hooks.append(lambda i, b: time.sleep(HB))
+        s = tr.run(30)
+        mgr.close()
+        assert s["resizes"] >= 1 and s["world"] == 1, s
+        assert s["steps"] == 30 and tr.data.cursor == 30
+        assert built[0] == 2 and built[-1] == 1
+        member.leave()
+    finally:
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# anomaly guards: nonfinite skip, loss-spike rollback, bounded budget
+# ---------------------------------------------------------------------------
+
+def test_nonfinite_skip_matches_amp_semantics():
+    """make_train_step(skip_nonfinite=True): a NaN batch's update never
+    happened — params/opt_state/step after [b0, NaN, b1] are
+    bit-identical to after [b0, b1] (the AMP overflow-skip rule
+    generalized to non-AMP training)."""
+    prog_a = _make_program(1)
+    prog_b = _make_program(1)
+    b0, b1 = _batch_fn(0), _batch_fn(1)
+    bad = (jnp.full((8, 3), jnp.nan, jnp.float32),
+           jnp.zeros((8, 2), jnp.float32))
+
+    flags = []
+    for batch in (b0, bad, b1):
+        _, skipped = prog_a.train_step(batch)
+        flags.append(bool(skipped))
+    for batch in (b0, b1):
+        prog_b.train_step(batch)
+
+    assert flags == [False, True, False]
+    assert int(prog_a.state.step) == int(prog_b.state.step) == 2
+    _assert_trees_bitwise_equal(prog_a.state, prog_b.state)
+
+
+def test_nan_injection_skips_and_advances_cursor(tmp_path):
+    """Driver-level view of the same guard: a chaos-poisoned batch is
+    consumed (cursor advances) but the model step never happened, and
+    the skip shows up in the stats/telemetry."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=5, spike_window=0)
+    plan = chaos.attach_train(tr, chaos.TrainChaosPlan(nan_at=[3]))
+    s = tr.run(8)
+    mgr.close()
+    assert plan.injected["nan"] == 1
+    assert s["skipped"] == 1 and s["steps"] == 8
+    assert tr.data.cursor == 8                  # batch consumed
+    assert int(tr.program.state.step) == 7      # update skipped
+    if tm.enabled():
+        assert "mxtpu_train_nonfinite_skips_total" in tm.prometheus()
+
+
+def test_loss_spike_rollback_recovers_bit_identically(tmp_path):
+    """A transient loss spike (corrupted batch, flipped bit) triggers
+    rollback to the last checkpoint; the replayed clean step makes the
+    run bit-identical to fault-free."""
+    _, ref_state = _run_reference(tmp_path / "ref", 8)
+
+    mgr = CheckpointManager(str(tmp_path / "chaos"), async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr, save_every=1,
+                        spike_window=3, spike_factor=5.0, max_rollbacks=2)
+    fired = []
+
+    def corrupt_once(i, batch):
+        if i == 5 and not fired:         # transient: gone on replay
+            fired.append(i)
+            x, y = batch
+            return (x, y + 1.0e4)
+
+    tr.pre_step_hooks.append(corrupt_once)
+    s = tr.run(8)
+    mgr.close()
+    assert s["rollbacks"] == 1 and s["steps"] == 8
+    _assert_trees_bitwise_equal(tr.program.state, ref_state)
+    if tm.enabled():
+        assert "mxtpu_train_loss_spike_rollbacks_total" in tm.prometheus()
+        kinds = [(r.get("kind"), r.get("name"))
+                 for r in tm.flight().tail(50)]
+        assert ("train", "rollback") in kinds
+
+
+def test_rollback_budget_exhaustion_raises(tmp_path):
+    """A PERSISTENT anomaly (the same batch NaNs out every replay, and
+    the program has no in-program skip) must not loop forever: the
+    bounded rollback budget ends the run with a loud error."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1, skip_nonfinite=False),
+                        JournaledData(_batch_fn), mgr, save_every=1,
+                        spike_window=3, max_rollbacks=1)
+    plan = chaos.attach_train(tr, chaos.TrainChaosPlan(nan_at=[3]))
+    with pytest.raises(ElasticError, match="rollback budget"):
+        tr.run(8)
+    mgr.close()
+    assert plan.injected["nan"] >= 2            # fired again on replay
+    assert tr._stats["rollbacks"] == 2
+
+
+# ---------------------------------------------------------------------------
+# preemption (SIGTERM) and torn checkpoints
+# ---------------------------------------------------------------------------
+
+def test_sigterm_preemption_final_save_and_resume(tmp_path):
+    """SIGTERM mid-run: the guard converts it to a step-boundary flag,
+    the driver force-saves checkpoint+journal and returns preempted;
+    a relaunch finishes bit-identical to fault-free."""
+    _, ref_state = _run_reference(tmp_path / "ref", 10)
+
+    d = str(tmp_path / "chaos")
+    mgr = CheckpointManager(d, async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=4, spike_window=0)
+    plan = chaos.attach_train(tr, chaos.TrainChaosPlan(sigterm_at=5))
+    with PreemptionGuard(mgr) as guard:
+        s = tr.run(10, guard=guard)
+    mgr.close()
+    assert plan.injected["sigterm"] == 1
+    assert s["preempted"] and s["steps"] < 10
+
+    mgr2 = CheckpointManager(d, async_save=False)
+    tr2 = ElasticTrainer(lambda w: _make_program(1),
+                         JournaledData(_batch_fn), mgr2,
+                         save_every=4, spike_window=0)
+    s2 = tr2.run(10)
+    mgr2.close()
+    assert s2["steps"] == 10 and s2["replayed"] == 0
+    _assert_trees_bitwise_equal(tr2.program.state, ref_state)
+
+
+def test_torn_checkpoint_falls_back_and_replays(tmp_path):
+    """A checkpoint torn AFTER commit (disk dying mid-flush) is skipped
+    by the newest-first scan with a warning + fallback telemetry; the
+    resume replays from the previous retained step and still converges
+    bit-identically."""
+    _, ref_state = _run_reference(tmp_path / "ref", 8)
+
+    d = str(tmp_path / "chaos")
+    mgr = CheckpointManager(d, async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=2, spike_window=0)
+    plan = chaos.attach_train(
+        tr, chaos.TrainChaosPlan(torn_checkpoint_at=6))
+    tr.run(6)
+    mgr.close()
+    assert plan.injected["torn_checkpoint"] == 1
+
+    consumed = []
+
+    def recording_batch_fn(i):
+        consumed.append(i)
+        return _batch_fn(i)
+
+    mgr2 = CheckpointManager(d, async_save=False)
+    tr2 = ElasticTrainer(lambda w: _make_program(1),
+                         JournaledData(recording_batch_fn), mgr2,
+                         save_every=2, spike_window=0)
+    with pytest.warns(RuntimeWarning, match="partial/corrupt"):
+        s2 = tr2.run(8)
+    mgr2.close()
+    # the fallback restored step 4, so batches 4,5 rerun relative to
+    # the killed incarnation — visible in the consumed indices (the
+    # "replayed" stat only counts intra-run rollback replays)
+    assert consumed == [4, 5, 6, 7]
+    assert s2["steps"] == 8
+    _assert_trees_bitwise_equal(tr2.program.state, ref_state)
+    if tm.enabled():
+        assert 'kind="fallback"' in tm.prometheus()
+
+
+def test_torn_manifest_recovery_both_consumers(tmp_path):
+    """The shared manifest/atomic-write discipline (base.manifest_commit
+    / manifest_read) behind BOTH the kvstore snapshot and the
+    data-position journal: a torn payload is detected (ManifestError),
+    and each consumer degrades the way its contract promises."""
+    # the primitive itself: corrupt payload -> ManifestError
+    p = str(tmp_path / "blob")
+    manifest_commit(p, b"payload-bytes")
+    assert manifest_read(p) == b"payload-bytes"
+    with open(p + ".payload", "wb") as f:
+        f.write(b"torn")
+    with pytest.raises(ManifestError):
+        manifest_read(p)
+
+    # consumer 1: kvstore server snapshot -> warns, starts empty
+    from mxtpu.kvstore import server as psrv
+    snap = str(tmp_path / "ps.snap")
+    port = chaos.free_port()
+    srv = psrv.KVStoreServer("127.0.0.1", port, snapshot_path=snap,
+                             snapshot_every=1)
+    cl = psrv.ServerClient("127.0.0.1", port)
+    cl.request("init", "k", onp.zeros(2, onp.float32))
+    cl.request("push", "k", onp.ones(2, onp.float32))
+    cl.close()
+    srv.stop()
+    with open(snap + ".payload", "wb") as f:
+        f.write(b"torn")
+    port2 = chaos.free_port()
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        srv2 = psrv.KVStoreServer("127.0.0.1", port2, snapshot_path=snap,
+                                  snapshot_every=1)
+    srv2.stop()
+
+    # consumer 2: a torn journal disqualifies its step — the resume
+    # scan falls back to the previous step whose PAIR validates
+    ckdir = str(tmp_path / "ck")
+    mgr = CheckpointManager(ckdir, async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=2, spike_window=0)
+    tr.run(6)
+    with open(mgr.journal_path(6) + ".payload", "wb") as f:
+        f.write(b"torn")
+    with pytest.warns(RuntimeWarning, match="journal step 6"):
+        _, journal, step = mgr.restore_with_journal(
+            _make_program(1).state_dict())
+    assert step == 4 and journal["cursor"] == 4
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint telemetry + mismatch diagnostics (satellites)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_telemetry_histograms(tmp_path):
+    """checkpoint_save_seconds / checkpoint_restore_seconds /
+    checkpoint_total{kind} land in the Prometheus scrape."""
+    if not tm.enabled():
+        pytest.skip("telemetry disabled in this environment")
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    state = {"w": jnp.ones((4,), jnp.float32)}
+    mgr.save(1, state)
+    mgr.restore(abstract_state=state)
+    mgr.save_journal(1, {"cursor": 1})
+    mgr.close()
+    text = tm.prometheus()
+    for fam in ("mxtpu_checkpoint_save_seconds",
+                "mxtpu_checkpoint_restore_seconds",
+                "mxtpu_checkpoint_total"):
+        assert f"# TYPE {fam}" in text, fam
+    parsed = tm.parse_prometheus(text)
+    assert parsed          # grammar holds with the new families present
+    for kind in ("save", "restore", "journal"):
+        assert f'kind="{kind}"' in text, kind
+
+
+def test_load_state_rejects_mismatched_tree(tmp_path):
+    """checkpoint.load_state against the wrong abstract tree names the
+    first mismatched key/shape instead of an orbax stack trace."""
+    p = str(tmp_path / "ck")
+    save_state(p, {"w": jnp.ones((3, 2), jnp.float32)})
+    with pytest.raises(MXNetError,
+                       match="does not match the provided state tree"):
+        load_state(p, {"w": jnp.zeros((4, 2), jnp.float32)})
+    with pytest.raises(MXNetError, match="missing"):
+        load_state(p, {"w": jnp.zeros((3, 2), jnp.float32),
+                       "b": jnp.zeros((2,), jnp.float32)})
+
+
+def test_trainer_load_states_rejects_mismatch(tmp_path):
+    """Trainer.load_states with states saved from a DIFFERENT net names
+    the offending parameter and shapes."""
+    mx.random.seed(3)
+    net_a = nn.Dense(4, in_units=3)
+    net_a.initialize()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.array(onp.ones((2, 3), onp.float32))
+    from mxtpu import autograd
+    with autograd.record():
+        loss = (net_a(x) ** 2).mean()
+    loss.backward()
+    tr_a.step(2)
+    fname = str(tmp_path / "states")
+    tr_a.save_states(fname)
+
+    net_b = nn.Dense(5, in_units=7)    # wrong shapes on purpose
+    net_b.initialize()
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        loss = (net_b(mx.nd.array(onp.ones((2, 7), onp.float32))) ** 2
+                ).mean()
+    loss.backward()
+    tr_b.step(2)
+    with pytest.raises(MXNetError, match="do not match"):
+        tr_b.load_states(fname)

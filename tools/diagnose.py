@@ -106,6 +106,40 @@ def gateway_state(addr: str = ""):
                   f"pressure={d['pressure']} p99={d['p99_ms']}")
 
 
+def elastic_state(addr: str = ""):
+    """Live elastic-training membership: generation, world size, and
+    per-host step/heartbeat-age rows scraped from a running
+    ``ElasticCoordinator``'s ``("state",)`` op
+    (``MXTPU_ELASTIC_COORD_ADDR=host:port``, or pass the address).
+    The same numbers ride the Prometheus scrape as
+    ``mxtpu_elastic_*``; this is the point-in-time table view."""
+    addr = addr or os.environ.get("MXTPU_ELASTIC_COORD_ADDR", "")
+    if not addr:
+        return None
+    host, _, port = addr.partition(":")
+    print(f"----------Elastic coordinator ({addr})----------")
+    try:
+        import socket
+        from mxtpu import rpc
+        secret = os.environ.get("MXTPU_ELASTIC_SECRET", "").encode()
+        with socket.create_connection((host, int(port or 9400)),
+                                      timeout=5.0) as s:
+            reply = rpc.call(s, ("state",), secret)
+    except Exception as e:
+        print(f"unreachable: {e!r}")
+        return False
+    if not (isinstance(reply, tuple) and reply and reply[0] == "ok"):
+        print(f"bad reply: {reply!r}")
+        return False
+    _, gen, target, world, rows = reply
+    resizing = "" if gen == target else \
+        f"  (RESIZING -> generation {target})"
+    print(f"generation={gen}  world={world}{resizing}")
+    for h, step, beat_age in rows:
+        print(f"  {h:<12} step={step:<8} last_beat={beat_age}s ago")
+    return True
+
+
 def _trace_files(trace_dir=None, paths=None):
     """The trace JSONL inputs: explicit paths, a directory of
     per-process streams, or whatever the env knobs point at."""
@@ -255,6 +289,13 @@ def _tail_disk_dump(n: int = 20):
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "elastic":
+        addr = sys.argv[2] if len(sys.argv) > 2 else ""
+        if not addr and not os.environ.get("MXTPU_ELASTIC_COORD_ADDR"):
+            print("usage: diagnose.py elastic <host:port>  (or set "
+                  "MXTPU_ELASTIC_COORD_ADDR)")
+            sys.exit(2)
+        sys.exit(0 if elastic_state(addr) else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "timeline":
         args = sys.argv[2:]
         if not args:
@@ -288,6 +329,7 @@ def main():
     print("libmxtpu native:", native.available())
     report()
     gateway_state()
+    elastic_state()
     _tail_disk_dump()
 
 
