@@ -40,6 +40,7 @@ def run_metadata():
     what silicon, how many devices, and whether the measured program
     recompiled mid-run — without cross-referencing the driver logs."""
     from mxtpu import telemetry
+    from mxtpu.telemetry import perfscope
     dev = jax.devices()[0]
     reg = telemetry.registry()
     recompiles = sum(
@@ -58,6 +59,15 @@ def run_metadata():
         "telemetry": {
             "compile_total": int(reg.value("jax_compile_total")),
             "recompile_total": int(recompiles),
+        },
+        # per-program cost-model snapshot (ISSUE 13): every watched or
+        # AOT-profiled program this process compiled, from the SAME
+        # perfscope catalog the live gauges read
+        "programs": {
+            name: {"flops": c.flops, "bytes_accessed": c.bytes_accessed,
+                   "peak_hbm_bytes": c.peak_hbm_bytes,
+                   "roofline": c.klass}
+            for name, c in sorted(perfscope.catalog().items())
         },
     }
 
@@ -107,7 +117,8 @@ def bench_resnet(batch=256, steps=30, stem=None):
     # 7.96 GFLOP/img per XLA cost_analysis (2-FLOPs-per-MAC units,
     # consistent with V5E_PEAK_FLOPS — the folklore "4.1 GFLOPs"
     # figure counts MACs)
-    mfu = img_s * 23.9e9 / V5E_PEAK_FLOPS
+    from mxtpu.telemetry import perfscope
+    mfu = perfscope.mfu(batch * 23.9e9, dt, peak_flops=V5E_PEAK_FLOPS)
     return img_s, mfu, stem
 
 
@@ -158,7 +169,8 @@ def bench_bert(batch=128, seq=128, n_mlm=20, steps=20):
     flops_per_step = (6 * n_dense * batch * seq +
                       6 * cfg.dim * cfg.vocab_size * batch * n_mlm +
                       12 * cfg.n_layers * cfg.dim * seq * batch * seq)
-    mfu = flops_per_step / dt / V5E_PEAK_FLOPS
+    from mxtpu.telemetry import perfscope
+    mfu = perfscope.mfu(flops_per_step, dt, peak_flops=V5E_PEAK_FLOPS)
     return samples_s, mfu
 
 
@@ -194,7 +206,9 @@ def bench_llama(batch=4, seq=2048, steps=15, cfg=None):
     # matmul and stays) + causal attention ≈ 6·L·d·s per token
     n_params, n_dense = _dense_param_count(params, ("tok_embed",))
     flops_per_token = 6 * n_dense + 6 * cfg.n_layers * cfg.dim * seq
-    mfu = tokens_s * flops_per_token / V5E_PEAK_FLOPS
+    from mxtpu.telemetry import perfscope
+    mfu = perfscope.mfu(batch * seq * flops_per_token, dt,
+                        peak_flops=V5E_PEAK_FLOPS)
     return tokens_s, mfu, n_params
 
 
@@ -493,11 +507,17 @@ def _aot8b_impl():
     t1 = time.perf_counter()
     compiled = lowered.compile()
     t_compile = time.perf_counter() - t1
-    state_gb = compiled.memory_analysis().argument_size_in_bytes / 1e9
+    from mxtpu.telemetry import perfscope
+    costs = perfscope.program_costs(compiled, name="aot8b_train_step",
+                                    spec=perfscope.spec_for("v5e"))
+    state_gb = costs["argument_bytes"] / 1e9
     return {"metric": "llama3_8b_aot_state_gb_per_device",
             "value": round(state_gb, 2), "unit": "GB",
             "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
             "compile_s": round(t_compile, 1),
+            "flops": costs["flops"],
+            "bytes_accessed": costs["bytes_accessed"],
+            "roofline": costs["roofline"],
             "mesh": "dp1_fsdp4_tp2_x8", "vs_baseline": None}
 
 
@@ -538,12 +558,14 @@ def _aot8b_decode_impl(batch=8, prefill_len=2048):
     t1 = time.perf_counter()
     compiled = lowered.compile()
     t_compile = time.perf_counter() - t1
-    mem = compiled.memory_analysis()
+    from mxtpu.telemetry import perfscope
+    costs = perfscope.program_costs(compiled, name="aot8b_decode",
+                                    spec=perfscope.spec_for("v5e"))
     # argument/peak sizes are per-device; temp_size on this backend is
     # whole-host across all partitions (the r3-gated train step shows
     # temp=79GB with peak=args=12.05GB), so peak is the honest HBM gate
-    args_gb = mem.argument_size_in_bytes / 1e9
-    peak_gb = mem.peak_memory_in_bytes / 1e9
+    args_gb = costs["argument_bytes"] / 1e9
+    peak_gb = costs["peak_hbm_bytes"] / 1e9
 
     # prefill for the same cache layout (chunked prompts re-enter it)
     abs_prompt = jax.ShapeDtypeStruct(
@@ -555,12 +577,18 @@ def _aot8b_decode_impl(batch=8, prefill_len=2048):
     t2 = time.perf_counter()
     pf_compiled = pf.lower(abs_params, abs_prompt, abs_cache).compile()
     t_pf = time.perf_counter() - t2
-    pf_peak_gb = pf_compiled.memory_analysis().peak_memory_in_bytes / 1e9
+    pf_costs = perfscope.program_costs(
+        pf_compiled, name="aot8b_prefill",
+        spec=perfscope.spec_for("v5e"))
+    pf_peak_gb = pf_costs["peak_hbm_bytes"] / 1e9
     return {"metric": "llama3_8b_decode_args_gb_per_device",
             "value": round(args_gb, 2), "unit": "GB",
             "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
             "compile_s": round(t_compile, 1),
             "peak_gb": round(peak_gb, 2),
+            "flops": costs["flops"],
+            "bytes_accessed": costs["bytes_accessed"],
+            "roofline": costs["roofline"],
             "prefill_compile_s": round(t_pf, 1),
             "prefill_peak_gb": round(pf_peak_gb, 2),
             "batch": batch, "ctx": ctx, "mesh": "tp8_bf16",
@@ -605,14 +633,19 @@ def _aot8b_int8_impl(batch=8):
     t1 = time.perf_counter()
     compiled = lowered.compile()
     t_compile = time.perf_counter() - t1
-    mem = compiled.memory_analysis()
-    args_gb = mem.argument_size_in_bytes / 1e9
-    peak_gb = mem.peak_memory_in_bytes / 1e9
+    from mxtpu.telemetry import perfscope
+    costs = perfscope.program_costs(compiled, name="aot8b_int8_decode",
+                                    spec=perfscope.spec_for("v5e"))
+    args_gb = costs["argument_bytes"] / 1e9
+    peak_gb = costs["peak_hbm_bytes"] / 1e9
     return {"metric": "llama3_8b_int8_decode_args_gb_per_device",
             "value": round(args_gb, 2), "unit": "GB",
             "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
             "compile_s": round(t_compile, 1),
             "peak_gb": round(peak_gb, 2),
+            "flops": costs["flops"],
+            "bytes_accessed": costs["bytes_accessed"],
+            "roofline": costs["roofline"],
             "batch": batch, "ctx": ctx, "mesh": "tp8_int8",
             "vs_baseline": None}
 
@@ -647,9 +680,11 @@ def _aot8b_32k_impl(batch=8, ctx=32768, chunk=1024):
     step = jax.jit(partial(llama.decode_step, cfg, mesh=mesh),
                    donate_argnums=(2,))
     compiled = step.lower(abs_params, abs_tok, abs_cache).compile()
-    mem = compiled.memory_analysis()
-    args_gb = mem.argument_size_in_bytes / 1e9
-    peak_gb = mem.peak_memory_in_bytes / 1e9
+    from mxtpu.telemetry import perfscope
+    costs = perfscope.program_costs(compiled, name="aot8b_32k_decode",
+                                    spec=perfscope.spec_for("v5e"))
+    args_gb = costs["argument_bytes"] / 1e9
+    peak_gb = costs["peak_hbm_bytes"] / 1e9
 
     # chunked prefill of a 30k prompt into the 32k cache (the last 2k
     # is generation headroom); scan keeps the HLO O(1) in chunk count
@@ -664,10 +699,16 @@ def _aot8b_32k_impl(batch=8, ctx=32768, chunk=1024):
     hlo_mb = len(lowered.as_text()) / 1e6
     pf_compiled = lowered.compile()
     t_pf = time.perf_counter() - t1
-    pf_peak_gb = pf_compiled.memory_analysis().peak_memory_in_bytes / 1e9
+    pf_costs = perfscope.program_costs(
+        pf_compiled, name="aot8b_32k_prefill",
+        spec=perfscope.spec_for("v5e"))
+    pf_peak_gb = pf_costs["peak_hbm_bytes"] / 1e9
     return {"metric": "llama3_8b_32k_decode_args_gb_per_device",
             "value": round(args_gb, 2), "unit": "GB",
             "peak_gb": round(peak_gb, 2),
+            "flops": costs["flops"],
+            "bytes_accessed": costs["bytes_accessed"],
+            "roofline": costs["roofline"],
             "prefill_peak_gb": round(pf_peak_gb, 2),
             "prefill_compile_s": round(t_pf, 1),
             "hlo_mb": round(hlo_mb, 2),
@@ -710,9 +751,11 @@ def _aot_moe_impl(batch=4, seq=2048):
     t1 = time.perf_counter()
     compiled = lowered.compile()
     t_compile = time.perf_counter() - t1
-    mem = compiled.memory_analysis()
-    train_gb = mem.argument_size_in_bytes / 1e9
-    train_peak = mem.peak_memory_in_bytes / 1e9
+    from mxtpu.telemetry import perfscope
+    costs = perfscope.program_costs(compiled, name="aot_moe_train_step",
+                                    spec=perfscope.spec_for("v5e"))
+    train_gb = costs["argument_bytes"] / 1e9
+    train_peak = costs["peak_hbm_bytes"] / 1e9
 
     # serving: bf16, pure tp8, dense-mixture experts, donated cache
     scfg = replace(cfg, param_dtype=jnp.bfloat16)
@@ -723,16 +766,20 @@ def _aot_moe_impl(batch=4, seq=2048):
     t2 = time.perf_counter()
     dc = dstep.lower(abs_sp, abs_tok, abs_cache).compile()
     t_dec = time.perf_counter() - t2
-    dmem = dc.memory_analysis()
+    dcosts = perfscope.program_costs(dc, name="aot_moe_decode",
+                                     spec=perfscope.spec_for("v5e"))
     return {"metric": "mixtral8x7b_aot_train_state_gb_per_device",
             "value": round(train_gb, 2), "unit": "GB",
             "n_params_b": round(n_params / 1e9, 2),
             "lower_s": round(t_lower, 1), "hlo_mb": round(hlo_mb, 2),
             "compile_s": round(t_compile, 1),
             "train_peak_gb": round(train_peak, 2),
+            "flops": costs["flops"],
+            "bytes_accessed": costs["bytes_accessed"],
+            "roofline": costs["roofline"],
             "decode_args_gb": round(
-                dmem.argument_size_in_bytes / 1e9, 2),
-            "decode_peak_gb": round(dmem.peak_memory_in_bytes / 1e9, 2),
+                dcosts["argument_bytes"] / 1e9, 2),
+            "decode_peak_gb": round(dcosts["peak_hbm_bytes"] / 1e9, 2),
             "decode_compile_s": round(t_dec, 1),
             "train_mesh": "dp1_fsdp2_ep2_tp2",
             "decode_mesh": "tp8_bf16", "vs_baseline": None}

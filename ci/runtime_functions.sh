@@ -370,6 +370,76 @@ finally:
     for p in procs:
         p.kill()
 PYEOF
+    # ISSUE 13 end to end in a fresh process: one train step and one
+    # serve request publish cost-model roofline gauges (program
+    # FLOPs, live MFU/MBU, KV reserved-vs-live, HBM headroom) on a
+    # SINGLE /metrics scrape, and `tools/diagnose.py perf` renders
+    # the roofline attribution table from that same scrape file.
+    python - << 'PYEOF'
+import os, subprocess, sys, tempfile
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+from mxtpu import telemetry as tm
+from mxtpu.models import llama
+from mxtpu.parallel import mesh as pmesh, step as pstep
+from mxtpu.serve import Request, ServeEngine
+
+cfg = llama.LlamaConfig(
+    vocab_size=64, dim=16, n_layers=2, n_heads=2, n_kv_heads=2,
+    hidden_dim=32, max_seq_len=16)
+mesh = pmesh.create_mesh(dp=-1)
+rules = llama.sharding_rules(cfg)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+tx = optax.adamw(1e-3)
+state = pstep.init_state(params, tx, mesh, rules)
+step = pstep.make_train_step(llama.loss_fn(cfg), tx, mesh, rules)
+batch = {"tokens": np.zeros((jax.device_count(), 16), np.int32)}
+for _ in range(3):
+    state, loss = step(state, batch)
+jax.block_until_ready(loss)
+
+scfg = llama.LlamaConfig(
+    vocab_size=64, dim=16, n_layers=2, n_heads=2, n_kv_heads=2,
+    hidden_dim=32, max_seq_len=32)
+sparams = llama.init_params(scfg, jax.random.PRNGKey(1))
+eng = ServeEngine(scfg, sparams, max_slots=2, max_len=32,
+                  min_bucket=4)
+eng.submit(Request(prompt=[1, 2, 3], max_new_tokens=4))
+eng.run()
+
+prom = tm.prometheus()
+s = tm.parse_prometheus(prom)["samples"]
+def val(name, **labels):
+    return s.get((name, tuple(sorted(labels.items()))), 0.0)
+assert val("mxtpu_program_flops", program="train_step") > 0, \
+    "train_step missing from cost catalog"
+assert val("mxtpu_program_flops", program="serve_decode") > 0, \
+    "serve_decode missing from cost catalog"
+assert any(k[0] == "mxtpu_mfu" for k in s), "no live MFU gauge"
+assert any(k[0] == "mxtpu_hbm_bw_util" for k in s), "no MBU gauge"
+assert val("mxtpu_serve_kv_reserved_bytes",
+           engine=eng.engine_id) > 0
+assert ("mxtpu_hbm_headroom_bytes", ()) in s, "no HBM headroom"
+assert val("mxtpu_hbm_ledger_bytes", category="params") > 0
+assert val("mxtpu_hbm_ledger_bytes", category="kv_slot_bank") > 0
+
+scrape = os.path.join(tempfile.mkdtemp(), "scrape.txt")
+open(scrape, "w").write(prom)
+r = subprocess.run(
+    [sys.executable, "tools/diagnose.py", "perf", scrape],
+    capture_output=True, text=True, timeout=120)
+assert r.returncode == 0, r.stdout + r.stderr
+assert "train_step" in r.stdout and "serve_decode" in r.stdout, \
+    r.stdout
+n_prog = sum(1 for k in s if k[0] == "mxtpu_program_flops")
+print(f"telemetry_smoke (perfscope): OK — {n_prog} cataloged "
+      f"programs, roofline table rendered from one scrape")
+print(r.stdout)
+PYEOF
 }
 
 opperf_gate() {

@@ -492,9 +492,15 @@ def make_fused_step(trainer, net, loss_fn: Optional[Callable] = None,
         # with trainer._amp_loss_scaler.loss_scale (kept coherent for
         # mixed classic/fused use), and donating it would invalidate
         # the scaler's reference
-        return jax.jit(_step, donate_argnums=(0, 1),
-                       out_shardings=(None, live_out_sh, state_out_sh,
-                                      amp_out_sh, None))
+        from .. import telemetry
+        # watched (transparent — _cache_size keeps delegating for the
+        # past_compiles accounting below): perfscope catalogs each
+        # rebuild's cost model and tracks live step pacing
+        return telemetry.watch(
+            jax.jit(_step, donate_argnums=(0, 1),
+                    out_shardings=(None, live_out_sh, state_out_sh,
+                                   amp_out_sh, None)),
+            "fused_step", expected=None, loop="train")
 
     def _trace_fp():
         """Signature over the TRACE-FROZEN knobs: everything the pure

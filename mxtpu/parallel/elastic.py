@@ -668,6 +668,10 @@ class ElasticTrainer:
                 "train_goodput_steps_per_s",
                 "Useful (committed, non-replayed) steps per wall "
                 "second since the driver started."),
+            # the unified goodput family (perfscope owns the single
+            # definition): train/serve pacing and elastic committed-
+            # step accounting scrape as ONE mxtpu_goodput_ratio
+            "goodput_ratio": telemetry.goodput_gauge("elastic"),
         }
 
     def _build(self) -> None:
@@ -808,6 +812,11 @@ class ElasticTrainer:
             if wall > 0:
                 counters["goodput"].set(
                     (self._stats["useful"] - useful0) / wall)
+            attempts = (self._stats["useful"] + self._stats["skipped"]
+                        + self._stats["replayed"])
+            if attempts > 0:
+                counters["goodput_ratio"].set(
+                    self._stats["useful"] / attempts)
             if i % self.save_every == 0 or i == total_steps:
                 self._save(i)
         self.manager.wait_until_finished()

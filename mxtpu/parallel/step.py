@@ -98,6 +98,14 @@ def init_state(params: Any, tx, mesh: Mesh,
             is_leaf=lambda s: isinstance(s, P))
         model_state = jax.jit(lambda t: jax.tree.map(jnp.copy, t),
                               out_shardings=msharding)(model_state)
+    # HBM ledger: the training state's resident footprint (one trainer
+    # per process is the deployed shape, so fixed names last-write-win)
+    from ..telemetry import perfscope
+    perfscope.ledger().account_tree("params", params, name="train")
+    perfscope.ledger().account_tree("optimizer", opt_state, name="train")
+    if model_state != ():
+        perfscope.ledger().account_tree("workspace", model_state,
+                                        name="train_model_state")
     return TrainState(params, opt_state, step, model_state)
 
 
@@ -209,6 +217,13 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
 
     from .. import telemetry
     telemetry.install_compile_listener()
+    # watched: every compile is cost-cataloged (program_flops/bytes →
+    # roofline class) and every dispatch feeds the live MFU/goodput
+    # gauges + step-anomaly detector. expected=None — tests legally
+    # run one step fn over several shapes; the serve-style recompile
+    # anomaly counter is not this program's contract.
+    watched = telemetry.watch(jitted, "train_step", expected=None,
+                              loop="train")
     dispatch_span = telemetry.span_factory("train.step_dispatch",
                                            "train_dispatch")
 
@@ -218,7 +233,7 @@ def make_train_step(loss_fn: Callable[..., Any], tx, mesh: Mesh,
         # this is the step-time split docs/observability.md reads:
         # device ≈ wall − data_wait − dispatch
         with dispatch_span():
-            return jitted(state, batch, rng)
+            return watched(state, batch, rng)
 
     step._jitted = jitted
     return step

@@ -63,14 +63,13 @@ _WAIT_STEP_BUCKETS = (0.0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 1024)
 _engine_seq = itertools.count(1)     # atomic: engines build on threads
 
 
-def _engine_metrics():
+def _engine_metrics(eid: str):
     """Process-wide serve metrics (one handle set per engine; the
     registry interns children, so every engine shares the TOTALS).
     Point-in-time gauges are labelled per engine instead — two live
     engines sharing one queue-depth gauge would just overwrite each
     other. Created at engine construction — the telemetry knob is
     read then."""
-    eid = str(next(_engine_seq))
     return {
         "requests": telemetry.counter(
             "serve_requests_total", "Requests submitted to ServeEngine"),
@@ -91,6 +90,18 @@ def _engine_metrics():
         "latency": telemetry.histogram(
             "serve_token_latency_ms",
             "Inter-token gaps per request (host emission clock)"),
+        # KV occupancy: the dense bank's reserved-vs-live waste number
+        # ROADMAP item 1 (paged KV) is gated on (perfscope ledger)
+        "kv_reserved": telemetry.gauge(
+            "serve_kv_reserved_bytes",
+            "Bytes the dense KV slot bank reserves", engine=eid),
+        "kv_live": telemetry.gauge(
+            "serve_kv_live_bytes",
+            "Bytes of the slot bank covered by live sequence "
+            "prefixes", engine=eid),
+        "kv_occ": telemetry.gauge(
+            "serve_kv_occupancy_ratio",
+            "live/reserved fraction of the KV slot bank", engine=eid),
     }
 
 
@@ -257,10 +268,12 @@ class ServeEngine:
         self._decode = telemetry.watch(
             jax.jit(partial(llama.decode_slots, cfg, mesh=mesh),
                     donate_argnums=(1,)),
-            "serve_decode", expected=1)
+            "serve_decode", expected=1, loop="serve")
         self._prefills: Dict[int, Any] = {}
         self._injects: Dict[int, Any] = {}
-        self._m = _engine_metrics()
+        eid = str(next(_engine_seq))
+        self.engine_id = eid
+        self._m = _engine_metrics(eid)
         self._m_cancel: Dict[str, Any] = {}    # per-reason counters
         # span factories pre-bind their registry histograms — the
         # per-step/per-admission hot paths must not re-intern handles
@@ -279,6 +292,26 @@ class ServeEngine:
         self._topks = np.full(S, cfg.vocab_size, np.int32)
         self._topps = np.ones(S, np.float32)
         self._slot_rid: List[Optional[int]] = [None] * S
+
+        # KV occupancy accounting: host-mirrored per-slot lengths (a
+        # prefill seats the prompt length; every decode dispatch adds
+        # one entry per active slot — exactly the device's `lengths`
+        # vector, tracked WITHOUT reading it back: a device sync here
+        # would block the decode loop every token, MXL004). Reserved
+        # bytes count the bank's global logical size across the mesh.
+        self._slot_len = np.zeros(S, np.int64)
+        itemsize = np.dtype(state["k"].dtype).itemsize
+        self._kv_tok_bytes = (2 * cfg.n_layers * cfg.n_kv_heads
+                              * cfg.head_dim * itemsize)
+        self._kv_reserved = int(state["k"].nbytes + state["v"].nbytes)
+        self._m["kv_reserved"].set(self._kv_reserved)
+        self._m["kv_live"].set(0)
+        self._m["kv_occ"].set(0.0)
+        from ..telemetry import perfscope
+        perfscope.ledger().account_tree("params", params,
+                                        name=f"engine{eid}")
+        perfscope.ledger().account("kv_slot_bank", self._kv_reserved,
+                                   name=f"engine{eid}")
 
         # batch mode (run()) returns the per-request token lists, so
         # it must retain them; a long-lived gateway replica must NOT —
@@ -518,6 +551,7 @@ class ServeEngine:
                 np.int32(self.cfg.vocab_size if req.top_k is None
                          else req.top_k),
                 np.float32(1.0 if req.top_p is None else req.top_p))
+        self._slot_len[slot] = prompt.size   # host mirror of lengths
         return tok
 
     def _inject_into(self, slot: int, h: KVHandoff):
@@ -540,6 +574,7 @@ class ServeEngine:
                 h.k, h.v, np.int32(h.true_len), np.int32(slot),
                 np.int32(h.token), np.asarray(h.rng, np.uint32),
                 self._kv, self._sv)
+        self._slot_len[slot] = h.true_len    # host mirror of lengths
         return np.asarray([h.token], np.int32)
 
     def _seat(self, slot: int, rid: int, req: Request) -> None:
@@ -562,6 +597,10 @@ class ServeEngine:
         self._m["steps"].inc()
         slots = [(s, rid) for s, rid in enumerate(self._slot_rid)
                  if self._active[s] and rid is not None]
+        # the decode program appends one cache entry per active slot;
+        # mirror that on the host (no readback — MXL004)
+        for s, _rid in slots:
+            self._slot_len[s] += 1
         return _Dispatch(sampled, slots, firsts)
 
     def _emit(self, rid: int, token: int, now: float) -> None:
@@ -605,6 +644,11 @@ class ServeEngine:
                     self._active[slot] = False   # recycle at the next
                     self._slot_rid[slot] = None  # step boundary
             self._m["slots"].set(int(self._active.sum()))
+            live = (int(self._slot_len[self._active].sum())
+                    * self._kv_tok_bytes)
+            self._m["kv_live"].set(live)
+            self._m["kv_occ"].set(live / self._kv_reserved
+                                  if self._kv_reserved else 0.0)
 
     # -- the serving loop ----------------------------------------------------
     def _loop_iter(self, prev: Optional[_Dispatch]
@@ -718,6 +762,23 @@ class ServeEngine:
         programs plus (disaggregated mode) inject programs; the
         compile bound is ``n_buckets + 1`` either way."""
         return len(self._prefills) + len(self._injects)
+
+    def kv_cache_stats(self) -> Dict[str, Any]:
+        """KV slot-bank occupancy: bytes the dense bank RESERVES vs
+        bytes live sequence prefixes actually COVER — the exact waste
+        number ROADMAP item 1 (paged KV) is gated on, surfaced in the
+        gateway ``/state`` block. Host arithmetic only (the mirrored
+        per-slot lengths; reading the device ``lengths`` vector here
+        would put a sync next to the decode loop — MXL004)."""
+        with self._lock:
+            active = int(self._active.sum())
+            live_tokens = int(self._slot_len[self._active].sum())
+        live = live_tokens * self._kv_tok_bytes
+        return {"slots": self.max_slots, "active": active,
+                "reserved_bytes": self._kv_reserved,
+                "live_bytes": live,
+                "occupancy": (live / self._kv_reserved
+                              if self._kv_reserved else 0.0)}
 
     def latency_stats(self) -> Dict[str, float]:
         """Per-token latency: p50/p99 over the gaps between a

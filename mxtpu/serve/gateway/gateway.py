@@ -805,7 +805,20 @@ class Gateway:
         breaker = self._breaker_snapshot()
         sup = (self.supervisor.describe()
                if self.supervisor else None)
-        return {"replicas": self.backend.state(),
+        replicas = self.backend.state()
+        # fleet KV occupancy: the dense-bank waste number, summed over
+        # every decode replica that reports one (perfscope's ledger
+        # carries the same bytes as gauges; this is the /state view)
+        kv_rows = [r["kv_cache"] for r in replicas
+                   if isinstance(r, dict) and r.get("kv_cache")]
+        reserved = sum(r["reserved_bytes"] for r in kv_rows)
+        live = sum(r["live_bytes"] for r in kv_rows)
+        kv_cache = {"slots": sum(r["slots"] for r in kv_rows),
+                    "active": sum(r["active"] for r in kv_rows),
+                    "reserved_bytes": reserved, "live_bytes": live,
+                    "occupancy": (live / reserved) if reserved else 0.0}
+        return {"replicas": replicas,
+                "kv_cache": kv_cache,
                 "n_replicas": self.backend.size,
                 "queued": load["queued"], "active": load["active"],
                 "slots": load["slots"], "queue_max": self.queue_max,

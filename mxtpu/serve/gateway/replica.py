@@ -322,6 +322,7 @@ class ReplicaSet:
                      failed=r.failed,
                      error=(repr(r.failure)[:120] if r.failure
                             else None), steps=r.engine.steps_run,
+                     kv_cache=r.engine.kv_cache_stats(),
                      **r.load())
                 for r in self.replicas()]
 

@@ -42,6 +42,8 @@ from .flight import (FlightRecorder, default_flight_path,
 from . import tracing as _tracing
 from .tracing import (Span, clear_trace, current_depth, dump_trace,
                       trace_events)
+from . import perfscope
+from .perfscope import goodput_gauge, profile_program
 from .watcher import WatchedFunction, describe_args, watch
 from .watcher import install as install_compile_listener
 
@@ -56,7 +58,7 @@ __all__ = [
     "install_compile_listener", "default_flight_path",
     "process_role", "set_process_role", "escape_label_value",
     "interval_percentile", "federate_text", "parse_prometheus",
-    "distributed",
+    "distributed", "perfscope", "profile_program", "goodput_gauge",
     "LATENCY_MS_BUCKETS", "BYTES_BUCKETS", "SECONDS_BUCKETS",
 ]
 
@@ -185,6 +187,9 @@ def reset() -> None:
     _REGISTRY.reset()
     clear_trace()
     _FLIGHT.clear()
+    # perfscope's rolling windows + ledger entries are test-visible
+    # state too (the cost catalog survives — program costs don't rot)
+    perfscope.reset()
 
 
 # the distributed layer registers the tracing context provider at

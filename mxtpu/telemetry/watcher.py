@@ -28,7 +28,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, List, Optional
+
+from . import perfscope as _perfscope
 
 __all__ = ["install", "watch", "WatchedFunction", "describe_args"]
 
@@ -115,7 +118,8 @@ class WatchedFunction:
     wrapped function, so existing jit-cache gates keep working."""
 
     def __init__(self, fn: Callable, name: str,
-                 expected: Optional[int] = 1):
+                 expected: Optional[int] = 1,
+                 loop: Optional[str] = None):
         if not hasattr(fn, "_cache_size"):
             raise TypeError(
                 f"watch() needs a jitted callable with _cache_size "
@@ -125,18 +129,30 @@ class WatchedFunction:
         self.name = name
         self.expected = expected
         self.compiles: List[str] = []       # cache key per compile
+        if loop is not None:
+            _perfscope.scope().set_loop(name, loop)
 
     def __call__(self, *args, **kwargs):
         fn = self._fn
         before = fn._cache_size()
+        t0 = time.perf_counter()
         out = fn(*args, **kwargs)
+        t1 = time.perf_counter()
         after = fn._cache_size()
         if after > before:
             self._on_compile(args, kwargs, after)
+        # perfscope step accounting: inter-dispatch gaps drive the
+        # live MFU/MBU/goodput gauges + the step-anomaly detector
+        _perfscope.scope().on_call(self.name, t0, t1)
         return out
 
     def _on_compile(self, args, kwargs, cache_size: int) -> None:
         from . import _metrics, flight as _fl
+        # a fresh compiled variant: catalog its XLA cost model (the
+        # lowering is still cached, so this is analysis, not a second
+        # compile; profile_program never raises)
+        _perfscope.scope().profile_program(self._fn, self.name,
+                                           args, kwargs)
         key = describe_args(args, kwargs)
         self.compiles.append(key)
         m = _metrics()
@@ -163,9 +179,12 @@ class WatchedFunction:
 
 
 def watch(fn: Callable, name: str,
-          expected: Optional[int] = 1) -> WatchedFunction:
+          expected: Optional[int] = 1,
+          loop: Optional[str] = None) -> WatchedFunction:
     """Wrap a jitted callable with compile attribution. ``expected``
     is the compile budget (cache entries) this program should ever
     need — 1 for a fixed-shape program; None disables the anomaly
-    counter (compiles are still attributed)."""
-    return WatchedFunction(fn, name, expected=expected)
+    counter (compiles are still attributed). ``loop`` tags the
+    program for perfscope's ``goodput_ratio{loop=...}`` gauge
+    (``"train"`` / ``"serve"``)."""
+    return WatchedFunction(fn, name, expected=expected, loop=loop)

@@ -266,6 +266,14 @@ def test_gateway_two_replicas_poisson_bit_identical(cfg, params):
         # both replicas exist and the scrape is well-formed
         st = gw.state()
         assert st["n_replicas"] == 2 and len(st["replicas"]) == 2
+        # ISSUE 13: per-replica + aggregate KV-cache occupancy ride
+        # /state (reserved is the static slot bank; the engines are
+        # drained here so live is back to 0)
+        kv = st["kv_cache"]
+        assert kv["reserved_bytes"] == sum(
+            r["kv_cache"]["reserved_bytes"] for r in st["replicas"])
+        assert kv["reserved_bytes"] > 0 and kv["slots"] > 0
+        assert 0.0 <= kv["occupancy"] <= 1.0
         status, prom = GatewayClient("127.0.0.1", port) \
             .get_text("/metrics")
         assert status == 200

@@ -13,6 +13,12 @@ PER-PROCESS trace JSONL files of a distributed serving run
 (``MXTPU_TELEMETRY_TRACE_DIR``) into ONE chrome://tracing-loadable
 JSON file for that request — front door, prefill worker, every decode
 replica it touched, and any crash re-dispatch seam, on one timeline.
+
+``python tools/diagnose.py perf [source]`` renders the perfscope
+roofline attribution table (program, cost-model FLOPs/bytes,
+compute- vs memory-bound class, live MFU, share of wall time) from
+one /metrics scrape — this process, a gateway address, or a saved
+scrape file.
 """
 import glob as _glob
 import json
@@ -270,6 +276,139 @@ def timeline(key, trace_dir=None, paths=None, out=None):
     return out, mine
 
 
+def _eng(v):
+    """Engineering-notation number for the roofline table columns."""
+    if v is None:
+        return "-"
+    for div, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suf}"
+    return f"{v:.0f}"
+
+
+def perf_rows(samples):
+    """Join one parsed scrape's ``mxtpu_program_*`` / ``mxtpu_mfu`` /
+    ``mxtpu_hbm_bw_util`` samples into roofline-table rows keyed by
+    (process, program). ``samples`` is ``parse_prometheus(text)
+    ["samples"]`` — so the same function renders an in-process dump, a
+    gateway scrape, or a FEDERATED scrape (rows then carry the process
+    label). Rows sort by share of attributed wall time within their
+    process, descending."""
+    rows = {}
+
+    def row(labels):
+        d = dict(labels)
+        prog = d.get("program")
+        if prog is None:
+            return None
+        return rows.setdefault((d.get("process", ""), prog), {
+            "process": d.get("process", ""), "program": prog,
+            "flops": None, "bytes_accessed": None,
+            "peak_hbm_bytes": None, "roofline": None,
+            "mfu": None, "hbm_bw_util": None, "wall_ms": 0.0})
+
+    for (name, labels), value in samples.items():
+        base = name[6:] if name.startswith("mxtpu_") else name
+        r = row(labels)
+        if r is None:
+            continue
+        if base == "program_flops":
+            r["flops"] = value
+        elif base == "program_bytes_accessed":
+            r["bytes_accessed"] = value
+        elif base == "program_peak_hbm_bytes":
+            r["peak_hbm_bytes"] = value
+        elif base == "program_roofline" and value:
+            r["roofline"] = dict(labels).get("class")
+        elif base == "mfu":
+            r["mfu"] = value
+        elif base == "hbm_bw_util":
+            r["hbm_bw_util"] = value
+        elif base == "program_wall_ms_total":
+            r["wall_ms"] = value
+    # a row is a program only if the cost catalog saw it (mfu/bw
+    # samples alone can't happen, but a scrape may be truncated)
+    rows = {k: r for k, r in rows.items()
+            if r["flops"] is not None or r["wall_ms"]}
+    totals = {}
+    for (proc, _), r in rows.items():
+        totals[proc] = totals.get(proc, 0.0) + (r["wall_ms"] or 0.0)
+    out = []
+    for (proc, _), r in sorted(rows.items()):
+        t = totals.get(proc, 0.0)
+        r["wall_share"] = (r["wall_ms"] or 0.0) / t if t > 0 else 0.0
+        out.append(r)
+    out.sort(key=lambda r: (r["process"], -r["wall_share"],
+                            r["program"]))
+    return out
+
+
+def perf(source: str = ""):
+    """``python tools/diagnose.py perf [source]`` — the roofline
+    attribution table from ONE /metrics scrape: program, cost-model
+    FLOPs and bytes, compute/memory-bound class, live MFU and HBM-BW
+    utilization, and each program's share of attributed wall time.
+
+    ``source``: empty reads THIS process's registry (or scrapes
+    ``MXTPU_GATEWAY_ADDR`` when set), ``host:port`` scrapes a running
+    gateway's /metrics, anything else is a path to a saved scrape."""
+    from mxtpu import telemetry
+    source = source or os.environ.get("MXTPU_GATEWAY_ADDR", "")
+    if not source:
+        text, origin = telemetry.prometheus(), "in-process"
+    elif os.path.exists(source):
+        with open(source) as f:
+            text = f.read()
+        origin = source
+    elif ":" in source:
+        host, _, port = source.partition(":")
+        try:
+            from mxtpu.serve.gateway import GatewayClient
+            status, text = GatewayClient(
+                host, int(port or 9300), timeout=5.0).get_text("/metrics")
+        except Exception as e:
+            print(f"perf: {source} unreachable: {e!r}")
+            return False
+        if status != 200:
+            print(f"perf: HTTP {status} from {source}")
+            return False
+        origin = source
+    else:
+        print(f"perf: no such file {source!r}")
+        return False
+    try:
+        parsed = telemetry.parse_prometheus(text)
+    except ValueError as e:
+        print(f"perf: malformed scrape from {origin}: {e}")
+        return False
+    rows = perf_rows(parsed["samples"])
+    print(f"----------Roofline attribution ({origin})----------")
+    if not rows:
+        print("no mxtpu_program_* samples in scrape (telemetry off, "
+              "or no watched program has compiled yet)")
+        return False
+    multi = any(r["process"] for r in rows)
+    hdr = (("process",) if multi else ()) + (
+        "program", "flops", "bytes", "class", "mfu", "bw_util",
+        "wall%")
+    lines = [hdr]
+    for r in rows:
+        cells = ((r["process"],) if multi else ()) + (
+            r["program"], _eng(r["flops"]), _eng(r["bytes_accessed"]),
+            r["roofline"] or "-",
+            "-" if r["mfu"] is None else f"{r['mfu']:.2%}",
+            "-" if r["hbm_bw_util"] is None
+            else f"{r['hbm_bw_util']:.2%}",
+            f"{r['wall_share']:.1%}")
+        lines.append(cells)
+    widths = [max(len(row[i]) for row in lines)
+              for i in range(len(hdr))]
+    for row in lines:
+        print("  ".join(c.ljust(w) for c, w in zip(row, widths))
+              .rstrip())
+    return True
+
+
 def _tail_disk_dump(n: int = 20):
     """A crashed process can't answer report() — but its flight dump
     on disk can."""
@@ -289,6 +428,9 @@ def _tail_disk_dump(n: int = 20):
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "perf":
+        source = sys.argv[2] if len(sys.argv) > 2 else ""
+        sys.exit(0 if perf(source) else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "elastic":
         addr = sys.argv[2] if len(sys.argv) > 2 else ""
         if not addr and not os.environ.get("MXTPU_ELASTIC_COORD_ADDR"):
