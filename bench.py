@@ -413,6 +413,334 @@ def bench_gateway(n_requests=32, n_replicas=2, max_slots=8,
             "total_s": round(dt, 1), "vs_baseline": None}
 
 
+# stdlib-only open-loop client (NO jax import: each swarm member is a
+# REAL separate process, cheap to fork, talking plain HTTP/1.0 — the
+# fleet bench's traffic must come from outside the server process or
+# the GIL serializes client and server and the queueing story is
+# fiction). argv: plan.json out.jsonl; the plan carries absolute
+# firing offsets, every job runs on its own thread (open loop).
+_FLEET_CLIENT_SRC = r"""
+import json, socket, sys, threading, time
+plan = json.load(open(sys.argv[1]))
+host, port = plan["host"], plan["port"]
+out = open(sys.argv[2], "w")
+lock = threading.Lock()
+t0 = time.perf_counter()
+
+def fire(job):
+    delay = t0 + job["at"] - time.perf_counter()
+    if delay > 0:
+        time.sleep(delay)
+    body = json.dumps({
+        "prompt": job["prompt"], "max_new_tokens": job["mnew"],
+        "temperature": job["temperature"], "seed": job["seed"],
+        "model": job["model"], "priority": job["priority"],
+        "session_id": job.get("session_id"), "stream": True}).encode()
+    rec = {"id": job["id"], "model": job["model"],
+           "priority": job["priority"], "seed": job["seed"],
+           "status": 0, "tokens": [], "reason": None,
+           "version": None, "ttft_ms": None}
+    try:
+        s = socket.create_connection((host, port), timeout=600)
+        t_send = time.perf_counter()
+        s.sendall(("POST /v1/generate HTTP/1.0\r\nHost: x\r\n"
+                   "Content-Length: %d\r\n"
+                   "Content-Type: application/json\r\n\r\n"
+                   % len(body)).encode() + body)
+        f = s.makefile("rb")
+        rec["status"] = int(f.readline().split()[1])
+        while f.readline().strip():
+            pass
+        if rec["status"] == 200:
+            for line in f:
+                evt = json.loads(line)
+                if evt.get("done"):
+                    rec["reason"] = evt.get("reason")
+                    rec["tokens"] = evt["tokens"]
+                    rec["version"] = evt.get("version")
+                    break
+                if rec["ttft_ms"] is None:
+                    rec["ttft_ms"] = 1e3 * (time.perf_counter()
+                                            - t_send)
+        f.close(); s.close()
+    except Exception as e:
+        rec["error"] = repr(e)
+    with lock:
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+
+threads = [threading.Thread(target=fire, args=(j,))
+           for j in plan["jobs"]]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+out.close()
+print("done", flush=True)
+"""
+
+
+def bench_fleet(seed=0, n_chat=44, chat_mnew=48, n_clients=3):
+    """Fleet control plane end to end (ISSUE 15 acceptance gate): two
+    tiny models behind ONE front door, hammered by a seeded Poisson
+    swarm of separate client PROCESSES with mixed priorities and
+    sessions, while a :class:`ServeChaosPlan` kills a replica and a
+    live checkpoint hot-swap replaces one model's weights mid-run.
+    Gated on the federated /metrics scrape:
+
+    - every completed request's tokens are bit-identical to a
+      per-request ``llama.generate`` with the weights of the BUILD
+      the response is labelled with (chaos kill and hot-swap
+      included);
+    - the arbiter demonstrably moves >= 1 chip from the idle model to
+      the burning one (``fleet_scale_events_total`` both directions)
+      and the hot model's SLO is not breached once the queue drains;
+    - batch traffic is shed first: ``gateway_shed_total`` has batch
+      sheds and ZERO interactive sheds, and interactive p99 TTFT
+      stays inside the SLO target through the burn."""
+    import os
+    import subprocess
+    import tempfile
+    import threading as _threading
+    from dataclasses import replace as _replace
+    from mxtpu import telemetry as tm
+    from mxtpu.contrib.chaos import ServeChaosPlan, attach_serve
+    from mxtpu.models import llama
+    from mxtpu.serve import ServeEngine
+    from mxtpu.serve.fleet import ArbiterPolicy, FleetGateway, ModelSpec
+    from mxtpu.serve.gateway import GatewayClient
+    from mxtpu.telemetry import parse_prometheus
+
+    cfg = _replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                   remat=False, attn_impl="dense", max_seq_len=64)
+    p_chat = llama.init_params(cfg, jax.random.PRNGKey(0))
+    p_chat_v1 = llama.init_params(cfg, jax.random.PRNGKey(1))
+    p_embed = llama.init_params(cfg, jax.random.PRNGKey(2))
+    by_build = {("chat", "v0"): p_chat, ("chat", "v1"): p_chat_v1,
+                ("embed", "v0"): p_embed}
+    rng = np.random.default_rng(seed)
+    plen, temp = 6, 0.7
+
+    def fac(params0):
+        return lambda params=params0: ServeEngine(
+            cfg, params, max_slots=2, max_len=64, min_bucket=8)
+
+    # batch sees 15% of the queue bound: the burst is sized so batch
+    # HITS its bound while interactive never reaches the full one —
+    # the shed-ordering assertion is then deterministic given arrival
+    # order, not CPU speed
+    os.environ["MXTPU_FLEET_BATCH_QUEUE_FRAC"] = "0.15"
+    peer_reg = tm.MetricsRegistry()
+    peer_reg.counter("fleet_bench_clients_total",
+                     "swarm driver federation probe").inc(n_clients)
+    peer = tm.RegistryServer(port=0, registry=peer_reg,
+                             process="swarm")
+    fleet = FleetGateway(
+        [ModelSpec("chat", fac(p_chat), replicas=1, min_replicas=1,
+                   max_replicas=2, slo={"ttft_ms": 30000.0}),
+         ModelSpec("embed", fac(p_embed), replicas=2, min_replicas=1,
+                   max_replicas=2)],
+        arbiter=ArbiterPolicy(chip_budget=3, interval_s=0.25,
+                              cooldown_s=1.0, pressure_high=1.5,
+                              occupancy_low=0.35, idle_s=0.8),
+        queue_max=64, federate=[("127.0.0.1", peer.port)])
+    chaos = attach_serve(fleet.pool("embed"),
+                         ServeChaosPlan(seed=seed,
+                                        kill_replica={0: 8}))
+    port = fleet.start_http(port=0)
+    reg = tm.registry()
+
+    def mkprompt():
+        return [int(t) for t in rng.integers(0, cfg.vocab_size, plen)]
+
+    tmp = tempfile.mkdtemp(prefix="mxtpu_fleet_")
+    try:
+        # warmup: the one prefill bucket + decode on every replica of
+        # both pools, outside the timed region (concurrent per pool so
+        # the least-loaded router spreads to cold replicas)
+        warm = []
+
+        def _warm(model, j):
+            warm.append(GatewayClient("127.0.0.1", port).generate(
+                mkprompt(), 4, seed=100 + j, temperature=temp,
+                model=model))
+
+        ws = [_threading.Thread(target=_warm, args=(m, j))
+              for j, m in enumerate(("chat", "embed", "embed"))]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        assert all(w["status"] == 200 for w in warm), warm
+
+        # the swarm plan: 4 embed requests then silence (the pool must
+        # go SUSTAINED-idle to become the donor), and a chat burst far
+        # above service rate (arrivals ~70/s): queue pressure is then
+        # guaranteed by arithmetic, not CPU timing
+        jobs = []
+        for i in range(4):
+            jobs.append(dict(id=len(jobs), model="embed",
+                             prompt=mkprompt(), mnew=16,
+                             temperature=temp, seed=len(jobs),
+                             priority="interactive",
+                             session_id=f"e{i % 2}",
+                             at=round(0.1 * i, 3)))
+        t_at = 0.3
+        for i in range(n_chat):
+            t_at += float(rng.exponential(0.013))
+            jobs.append(dict(id=len(jobs), model="chat",
+                             prompt=mkprompt(), mnew=chat_mnew,
+                             temperature=temp, seed=len(jobs),
+                             priority=("interactive" if i % 2 == 0
+                                       else "batch"),
+                             session_id=(f"s{i % 6}" if i % 2 == 0
+                                         else None),
+                             at=round(t_at, 3)))
+        procs, outs = [], []
+        for c in range(n_clients):
+            pf = os.path.join(tmp, f"plan{c}.json")
+            of = os.path.join(tmp, f"out{c}.jsonl")
+            with open(pf, "w") as fh:
+                json.dump({"host": "127.0.0.1", "port": port,
+                           "jobs": jobs[c::n_clients]}, fh)
+            outs.append(of)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _FLEET_CLIENT_SRC, pf, of],
+                stdout=subprocess.PIPE, text=True))
+        t0 = time.perf_counter()
+        fleet.metrics_text()        # opens the goodput window
+
+        # wait for the chip MOVE (embed sustained-idle donates, chat
+        # burning claims), then for the queue to subside, then swap
+        # chat's weights LIVE while stragglers are still in flight
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if reg.value("fleet_scale_events_total", model="chat",
+                         direction="up") >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                "arbiter never granted the burning pool a chip: "
+                f"{fleet.arbiter.describe()}")
+        while (fleet.pool("chat").load_total()["queued"] > 4
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        swap = fleet.hot_swap("chat", params=p_chat_v1)
+        assert swap["version"] == "v1", swap
+
+        # post-swap verification traffic: same sessions, new build
+        post = []
+        post_prompts = [mkprompt() for _ in range(8)]
+
+        def _post(j):
+            rec = GatewayClient(
+                "127.0.0.1", port, timeout=600).generate(
+                    post_prompts[j], 16, seed=500 + j,
+                    temperature=temp, model="chat",
+                    priority="interactive", session_id=f"s{j % 6}")
+            post.append((j, rec))
+
+        ps = [_threading.Thread(target=_post, args=(j,))
+              for j in range(8)]
+        for t in ps:
+            t.start()
+        for t in ps:
+            t.join()
+        for p in procs:
+            assert p.wait(timeout=600) == 0
+        dt = time.perf_counter() - t0
+        results = [json.loads(l) for of in outs
+                   for l in open(of)]
+    finally:
+        text = fleet.metrics_text()
+        fleet.close()
+        peer.close()
+        os.environ.pop("MXTPU_FLEET_BATCH_QUEUE_FRAC", None)
+
+    # -- gate 1: bit-identity, per BUILD, chaos + swap included ---------
+    jmap = {j["id"]: j for j in jobs}
+    refs = {}
+
+    def ref(model, version, prompt, mnew, seed_):
+        key = (model, version, mnew)
+        if key not in refs:
+            refs[key] = jax.jit(lambda p, pr, r: llama.generate(
+                cfg, p, pr, mnew, temperature=temp, rng=r))
+        out = refs[key](by_build[(model, version)],
+                        jnp.asarray(prompt, jnp.int32)[None],
+                        jax.random.PRNGKey(seed_))
+        return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+    done = [r for r in results if r["status"] == 200]
+    for r in done:
+        j = jmap[r["id"]]
+        want = ref(r["model"], r["version"], j["prompt"], j["mnew"],
+                   r["seed"])
+        assert r["tokens"] == want[:len(r["tokens"])], (
+            f"divergence on job {r['id']} "
+            f"({r['model']}@{r['version']}): {r['tokens']} != {want}")
+    for j, r in post:
+        assert r["status"] == 200 and r["version"] == "v1", r
+        want = ref("chat", "v1", post_prompts[j], 16, 500 + j)
+        assert r["tokens"] == want[:len(r["tokens"])], (j, r, want)
+    total_new = sum(len(r["tokens"]) for r in done)
+    assert chaos.injected["replica_kill"] == 1, chaos.injected
+    assert len([r for r in done if r["model"] == "embed"]) >= 1
+    assert len(done) >= 10, f"only {len(done)} completed"
+
+    # -- gate 2+3: federated scrape carries the whole story -------------
+    parsed = parse_prometheus(text)
+    s = parsed["samples"]
+
+    def sval(name, **labels):
+        return s.get((name, tuple(sorted(labels.items()))), 0.0)
+
+    assert sval("mxtpu_fleet_scale_events_total", model="chat",
+                direction="up") >= 1, s
+    assert sval("mxtpu_fleet_scale_events_total", model="embed",
+                direction="down") >= 1, s
+    assert sval("mxtpu_fleet_swap_total", model="chat") >= 1
+    assert sval("mxtpu_fleet_bench_clients_total",
+                process="swarm") == n_clients, "federation broken"
+    # the aggregate series only: federation ALSO exports every sample
+    # per-process, and summing both would double-count
+    batch_shed = sum(v for (n, lab), v in s.items()
+                     if n == "mxtpu_gateway_shed_total"
+                     and dict(lab).get("priority") == "batch"
+                     and "process" not in dict(lab))
+    inter_shed = sum(v for (n, lab), v in s.items()
+                     if n == "mxtpu_gateway_shed_total"
+                     and dict(lab).get("priority") == "interactive"
+                     and "process" not in dict(lab))
+    assert batch_shed > 0, "burst never shed batch traffic"
+    assert inter_shed == 0, f"{inter_shed} interactive sheds"
+    assert ("mxtpu_goodput_ratio", (("loop", "fleet"),)) in s
+    assert not fleet.gateway("chat").slo.breached, \
+        "chat SLO still burning after the chip grant"
+
+    ttfts = sorted(r["ttft_ms"] for r in done
+                   if r["priority"] == "interactive"
+                   and r["ttft_ms"] is not None)
+    p99 = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))] \
+        if ttfts else 0.0
+    assert p99 < 30000.0, f"interactive p99 TTFT {p99}ms out of SLO"
+    n429 = len([r for r in results if r["status"] == 429])
+    return {"metric": "fleet_gateway_tokens_per_s",
+            "value": round(total_new / dt, 1), "unit": "tok/s",
+            "n_jobs": len(jobs), "n_ok": len(done), "n_shed": n429,
+            "batch_shed": int(batch_shed),
+            "interactive_ttft_p99_ms": round(p99, 1),
+            "scale_up_chat": int(sval("mxtpu_fleet_scale_events_total",
+                                      model="chat", direction="up")),
+            "scale_down_embed": int(sval(
+                "mxtpu_fleet_scale_events_total", model="embed",
+                direction="down")),
+            "swap": swap, "chaos_injected": dict(chaos.injected),
+            "n_clients": n_clients, "total_s": round(dt, 1),
+            "vs_baseline": None}
+
+
 def _on_cpu_mesh(impl_fn_name: str, n: int = 8):
     """Run ``bench.<impl_fn_name>()`` on an n-device virtual CPU mesh:
     directly when this process already is one, else via re-exec (same
@@ -1088,16 +1416,19 @@ def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
     if only not in ("all", "resnet", "bert", "llama", "smoke", "aot8b",
                     "aot8b_decode", "aot_moe", "aot8b_int8", "aot8b_32k",
-                    "input", "serve", "gateway"):
+                    "input", "serve", "gateway", "fleet"):
         raise SystemExit(
             "usage: bench.py [all|resnet|bert|llama|smoke|aot8b|"
             "aot8b_decode|aot_moe|aot8b_int8|aot8b_32k|input|serve|"
-            f"gateway|gate ...] (got {only!r})")
+            f"gateway|fleet|gate ...] (got {only!r})")
     if only == "serve":
         _emit(bench_llama_serve())
         return
     if only == "gateway":
         _emit(bench_gateway())
+        return
+    if only == "fleet":
+        _emit(bench_fleet())
         return
     if only == "smoke":
         _emit(bench_smoke_run())

@@ -192,6 +192,95 @@ print(f"gateway_smoke: OK (4 streamed tokens bit-identical, "
 PYEOF
 }
 
+fleet_smoke() {
+    # the fleet control plane end to end in a fresh process
+    # (docs/serving.md §"Fleet control plane"): a two-model fleet
+    # gateway behind one HTTP front door, one streamed request per
+    # model checked bit-identical against per-request generate (the
+    # responses carrying model + build-version labels), one live
+    # checkpoint hot-swap with zero dropped requests, and the
+    # FEDERATED /metrics scrape validated — per-model series plus a
+    # peer process's series under strict Prometheus grammar. The full
+    # contract (arbiter chip moves, priority shed ordering, chaos
+    # mid-swap) is tier-1 in tests/test_fleet.py; this proves the
+    # service path with no pytest fixtures.
+    python - << 'PYEOF'
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+import jax.numpy as jnp
+from dataclasses import replace
+from mxtpu import telemetry as tm
+from mxtpu.models import llama
+from mxtpu.serve import ServeEngine
+from mxtpu.serve.gateway import GatewayClient
+from mxtpu.serve.fleet import FleetGateway, ModelSpec
+
+cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32, remat=False,
+              attn_impl="dense")
+pa = llama.init_params(cfg, jax.random.PRNGKey(0))
+pb = llama.init_params(cfg, jax.random.PRNGKey(1))
+
+def fac(p0):
+    return lambda params=p0: ServeEngine(cfg, params, max_slots=2,
+                                         max_len=32, min_bucket=4)
+
+peer_reg = tm.MetricsRegistry()
+peer_reg.counter("ci_fleet_peer_total", "federation probe").inc(3)
+peer = tm.RegistryServer(port=0, registry=peer_reg, process="worker0")
+fleet = FleetGateway(
+    [ModelSpec("alpha", fac(pa)), ModelSpec("beta", fac(pb))],
+    supervise=False, federate=[("127.0.0.1", peer.port)])
+port = fleet.start_http(port=0)
+cli = GatewayClient("127.0.0.1", port)
+rng = np.random.default_rng(13)
+prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 5)]
+
+def ref(params, seed):
+    out = llama.generate(cfg, params,
+                         jnp.asarray(prompt, jnp.int32)[None], 4,
+                         rng=jax.random.PRNGKey(seed))
+    return [int(t) for t in np.asarray(out)[0, 5:]]
+
+ra = cli.generate(prompt, 4, seed=2, model="alpha")
+rb = cli.generate(prompt, 4, seed=2, model="beta")
+for rec, p in ((ra, pa), (rb, pb)):
+    assert rec["status"] == 200 and rec["reason"] == "complete", rec
+    assert rec["tokens"] == ref(p, 2), rec
+assert (ra["model"], ra["version"]) == ("alpha", "v0"), ra
+assert ra["tokens"] != rb["tokens"], "two models, one output"
+
+# live hot-swap: alpha takes beta's weights, nothing dropped, the
+# next response carries the new build label and its tokens
+swap = fleet.hot_swap("alpha", params=pb)
+assert swap["version"] == "v1" and swap["swapped"] == 1, swap
+r2 = cli.generate(prompt, 4, seed=2, model="alpha")
+assert r2["status"] == 200 and r2["version"] == "v1", r2
+assert r2["tokens"] == ref(pb, 2), r2
+
+status, prom = cli.get_text("/metrics")
+assert status == 200
+parsed = tm.parse_prometheus(prom)          # strict grammar
+s = parsed["samples"]
+assert s[("mxtpu_gateway_requests_total",
+          (("code", "accepted"), ("model", "alpha")))] >= 2
+assert s[("mxtpu_fleet_swap_total", (("model", "alpha"),))] == 1
+assert s[("mxtpu_ci_fleet_peer_total",
+          (("process", "worker0"),))] == 3, "federation broken"
+status, state = cli.get_json("/state")
+assert status == 200 and set(state["models"]) == {"alpha", "beta"}
+assert state["models"]["alpha"]["version"] == "v1", state
+fleet.close()
+peer.close()
+print(f"fleet_smoke: OK (2 models bit-identical, hot-swap to "
+      f"{swap['version']}, {len(prom.splitlines())} federated "
+      f"metric lines)")
+PYEOF
+}
+
 chaos_serve() {
     # serving-tier fault tolerance (docs/robustness.md §serving): the
     # seeded gateway-chaos suite — replica kill under a Poisson client
@@ -574,6 +663,7 @@ ci_all() {
     bench_smoke
     serve_smoke
     gateway_smoke
+    fleet_smoke
     chaos_serve
     chaos_train
     telemetry_smoke
@@ -592,6 +682,7 @@ ci_fast() {
     bench_smoke
     serve_smoke
     gateway_smoke
+    fleet_smoke
     chaos_serve
     chaos_train
     telemetry_smoke

@@ -25,12 +25,12 @@ from .engine import (KVHandoff, Request, ServeEngine, bucket_for,
                      resume_key)
 
 __all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for",
-           "resume_key", "gateway"]
+           "resume_key", "gateway", "fleet"]
 
 
 def __getattr__(name):
-    if name == "gateway":
+    if name in ("gateway", "fleet"):
         import importlib
-        return importlib.import_module(".gateway", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute "
                          f"{name!r}")

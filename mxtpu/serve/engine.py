@@ -254,6 +254,11 @@ class ServeEngine:
         # overwrites it with the replica name, so a request that moves
         # replicas shows WHICH bank served each segment)
         self.role = "engine"
+        # model-build tag (fleet pools stamp this with the pool's
+        # checkpoint version at spawn): joins the role in trace
+        # events, so a timeline spanning a hot-swap shows which BUILD
+        # served each segment, not just which replica
+        self.build: Optional[str] = None
 
         state = llama.init_slot_cache(cfg, self.max_slots,
                                       self.max_len, mesh=mesh)
@@ -442,7 +447,7 @@ class ServeEngine:
         if req.ctx is not None:
             with dtrace.use(req.ctx):
                 telemetry.instant("serve.done", reason=reason,
-                                  role=self.role)
+                                  role=self.role, build=self.build)
         if req.on_done is not None:
             req.on_done(rid, reason)
         if not self.retain_results:

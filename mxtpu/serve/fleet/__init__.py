@@ -1,0 +1,9 @@
+"""Fleet control plane: multi-model, multi-tenant serving over the
+gateway — named-model routing, SLO-driven chip arbitration between
+per-model pools, priority classes, live checkpoint hot-swap, and
+session affinity. See docs/serving.md §"Fleet control plane"."""
+from .arbiter import ArbiterPolicy, FleetArbiter
+from .fleet import FleetGateway, FleetPool, ModelSpec
+
+__all__ = ["ArbiterPolicy", "FleetArbiter", "FleetGateway",
+           "FleetPool", "ModelSpec"]

@@ -1,0 +1,288 @@
+"""SLO-driven chip arbitration across per-model pools: ONE allocator
+for the whole fleet, replacing per-model autoscaling.
+
+A per-model autoscaler sees only its own queue and p99 — two
+autoscalers on one chip budget either both hold their maximum
+(stranding chips on the cold model) or fight over the free pool. The
+arbiter reads every pool's signals TOGETHER each tick and moves whole
+replicas' worth of chips between them (the AlpaServe observation:
+cross-model placement on a shared budget is where utilization is won):
+
+- a pool is HOT when its queue pressure exceeds ``pressure_high`` or
+  its SLO burn rate exceeds ``burn_high`` (the PR 8 ``SLOTracker``
+  burn, read per model — the tracker itself does the windowing);
+- a pool is a DONOR when it has been sustained-idle (empty queue, low
+  occupancy) for ``idle_s`` and sits above its ``min_replicas``;
+- each tick grants at most ONE replica to the hottest pool — from the
+  free budget if any, else by shrinking the coldest donor first (the
+  chip MOVE the fleet bench asserts); with no claimant, one
+  sustained-idle pool shrinks to return chips to the free budget.
+
+Hysteresis is the autoscaler's (deliberately boring) discipline
+reused fleet-wide: per-model cooldowns between decisions, sustained
+idle before donating, one replica per tick. Every decision increments
+``fleet_scale_events_total{model,direction}`` and lands in the flight
+recorder with the signals that drove it; ``fleet_chips_in_use{model}``
+/ ``fleet_chips_free`` are the live ledger. The loop is a pure
+function of (clock, signals): tests inject both and single-step
+:meth:`FleetArbiter.tick`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ... import telemetry
+from ..gateway.replica import GatewayClosed
+
+__all__ = ["ArbiterPolicy", "FleetArbiter"]
+
+
+@dataclass
+class ArbiterPolicy:
+    chip_budget: int = 0          # 0 = derived: the fleet's initial
+    #                               allocation (sum of replicas*chips)
+    interval_s: float = 1.0       # loop period
+    cooldown_s: float = 10.0      # per-model gap between decisions
+    pressure_high: float = 2.0    # un-seated requests per replica
+    burn_high: float = 1.0        # SLO burn rate over = hot
+    occupancy_low: float = 0.25   # idle ceiling (donor eligibility)
+    idle_s: Optional[float] = None   # sustained idle before donating;
+    #                                  None = cooldown_s
+
+    def __post_init__(self):
+        if self.interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, "
+                             f"got {self.interval_s}")
+        if self.cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, "
+                             f"got {self.cooldown_s}")
+
+
+class FleetArbiter:
+    """Arbitrates ``policy.chip_budget`` chips between the fleet's
+    pools. ``entries`` is the fleet's LIVE ``{name: entry}`` mapping
+    (each entry carries ``.pool`` — size, bounds, chips_per_replica,
+    scale_to — and ``.gateway`` — whose ``slo`` tracker supplies the
+    burn rate); reading it live means models registered after
+    construction are arbitrated too.
+
+    ``signals``: optional ``fn(name, entry) -> {"pressure",
+    "occupancy", "burn", "queued", "size"}`` override — the
+    deterministic-test hook (synthetic burn without real latency)."""
+
+    def __init__(self, entries: Dict[str, Any], policy: ArbiterPolicy,
+                 *, clock: Optional[Callable[[], float]] = None,
+                 signals: Optional[Callable[[str, Any],
+                                            Dict[str, float]]] = None):
+        self.entries = entries
+        self.policy = policy
+        self._clock = clock or time.monotonic
+        self._signals_override = signals
+        self.budget = int(policy.chip_budget) if policy.chip_budget \
+            else sum(e.pool.size * self._cpr(n)
+                     for n, e in entries.items())
+        self._idle_since: Dict[str, float] = {}
+        self._last_scale: Dict[str, float] = {}
+        self._m_events: Dict[tuple, Any] = {}
+        self._m_chips: Dict[str, Any] = {}
+        self._m_free = telemetry.gauge(
+            "fleet_chips_free",
+            "Chips of the fleet budget not allocated to any pool")
+        self.decisions: List[Dict[str, Any]] = []   # bounded: tick()
+
+    def _cpr(self, name: str) -> int:
+        entry = self.entries.get(name)
+        return int(getattr(entry.pool, "chips_per_replica", 1)
+                   if entry is not None else 1)
+
+    def _bounds(self, name: str) -> tuple:
+        pool = self.entries[name].pool
+        return (int(getattr(pool, "min_replicas", 1)),
+                int(getattr(pool, "max_replicas", 1 << 30)))
+
+    def _signals(self, name: str, entry) -> Dict[str, float]:
+        """Default signal read: pool load at the source (the same
+        numbers the autoscaler used) + the model's SLO burn rate.
+        ``slo.tick()`` is rate-limited to its own window, so arbiter
+        cadence cannot chop the burn computation into noise."""
+        pool = entry.pool
+        load = pool.load_total()
+        n = pool.size
+        burn = 0.0
+        slo = getattr(entry.gateway, "slo", None)
+        if slo is not None:
+            snap = slo.tick()
+            burns = [v.get("burn") for v in snap.values()
+                     if v.get("burn") is not None]
+            if burns:
+                burn = max(burns)
+        return {"pressure": load["queued"] / max(1, n),
+                "occupancy": load["active"] / max(1, load["slots"]),
+                "queued": float(load["queued"]),
+                "size": float(n), "burn": float(burn)}
+
+    def _count_event(self, model: str, direction: str) -> None:
+        key = (model, direction)
+        m = self._m_events.get(key)
+        if m is None:
+            m = self._m_events[key] = telemetry.counter(
+                "fleet_scale_events_total",
+                "Fleet arbiter decisions, by model and direction",
+                model=model, direction=direction)
+        m.inc()
+
+    def _scale(self, name: str, delta: int, now: float, *,
+               reason: str,
+               sigs: Dict[str, Dict[str, float]]
+               ) -> Optional[Dict[str, Any]]:
+        entry = self.entries.get(name)
+        if entry is None:
+            return None
+        n = entry.pool.size
+        try:
+            entry.pool.scale_to(n + delta)
+        except GatewayClosed:
+            # a tick racing fleet shutdown: the pool refused loudly —
+            # stand down, record nothing
+            return None
+        direction = "up" if delta > 0 else "down"
+        self._last_scale[name] = now
+        self._idle_since.pop(name, None)
+        self._count_event(name, direction)
+        s = sigs.get(name, {})
+        record = {"t": now, "model": name, "direction": direction,
+                  "from": n, "to": n + delta, "reason": reason,
+                  "pressure": round(s.get("pressure", 0.0), 3),
+                  "occupancy": round(s.get("occupancy", 0.0), 3),
+                  "burn": round(s.get("burn", 0.0), 3)}
+        telemetry.flight().record("fleet", "scale", **record)
+        self.decisions.append(record)
+        del self.decisions[:-64]
+        return record
+
+    def tick(self) -> List[Dict[str, Any]]:
+        """One arbitration pass; returns the decisions made (possibly
+        a down on a donor AND an up on the claimant — the chip
+        move)."""
+        pol = self.policy
+        now = self._clock()
+        sigs: Dict[str, Dict[str, float]] = {}
+        for name, entry in list(self.entries.items()):
+            try:
+                sigs[name] = (
+                    self._signals_override(name, entry)
+                    if self._signals_override is not None
+                    else self._signals(name, entry))
+            except GatewayClosed:
+                continue
+        # idle bookkeeping (donor eligibility needs SUSTAINED idle —
+        # one quiet tick between bursts must not donate a replica)
+        for name, s in sigs.items():
+            hot_sig = (s["pressure"] > pol.pressure_high
+                       or s["burn"] > pol.burn_high)
+            if (not hot_sig and s["queued"] == 0
+                    and s["occupancy"] < pol.occupancy_low):
+                self._idle_since.setdefault(name, now)
+            else:
+                self._idle_since.pop(name, None)
+        idle_need = (pol.idle_s if pol.idle_s is not None
+                     else pol.cooldown_s)
+
+        def in_cooldown(name: str) -> bool:
+            t = self._last_scale.get(name)
+            return t is not None and now - t < pol.cooldown_s
+
+        hot = sorted(
+            (n for n, s in sigs.items()
+             if (s["pressure"] > pol.pressure_high
+                 or s["burn"] > pol.burn_high)
+             and s["size"] < self._bounds(n)[1]
+             and not in_cooldown(n)),
+            key=lambda n: (sigs[n]["burn"], sigs[n]["pressure"]),
+            reverse=True)
+        donors = sorted(
+            (n for n, s in sigs.items()
+             if s["size"] > self._bounds(n)[0]
+             and not in_cooldown(n)
+             and n in self._idle_since
+             and now - self._idle_since[n] >= idle_need),
+            key=lambda n: (sigs[n]["pressure"], sigs[n]["occupancy"]))
+
+        used = sum(int(s["size"]) * self._cpr(n)
+                   for n, s in sigs.items())
+        free = self.budget - used
+        decisions: List[Dict[str, Any]] = []
+        if hot:
+            name = hot[0]
+            need = self._cpr(name)
+            for donor in (d for d in donors if d != name):
+                if free >= need:
+                    break
+                d = self._scale(donor, -1, now,
+                                reason=f"yield->{name}", sigs=sigs)
+                if d is not None:
+                    decisions.append(d)
+                    free += self._cpr(donor)
+            if free >= need:
+                d = self._scale(name, +1, now, reason="hot",
+                                sigs=sigs)
+                if d is not None:
+                    decisions.append(d)
+        elif donors:
+            # nothing is burning: return ONE sustained-idle replica's
+            # chips to the free budget (the next hot tick grants them
+            # without waiting on a donor's cooldown)
+            d = self._scale(donors[0], -1, now, reason="idle",
+                            sigs=sigs)
+            if d is not None:
+                decisions.append(d)
+
+        # live chip ledger (post-decision sizes)
+        used = 0
+        for name, entry in list(self.entries.items()):
+            chips = entry.pool.size * self._cpr(name)
+            used += chips
+            g = self._m_chips.get(name)
+            if g is None:
+                g = self._m_chips[name] = telemetry.gauge(
+                    "fleet_chips_in_use",
+                    "Chips currently allocated to the model's pool",
+                    model=name)
+            g.set(chips)
+        self._m_free.set(max(0, self.budget - used))
+        return decisions
+
+    def last_decision(self, model: str) -> Optional[Dict[str, Any]]:
+        """Most recent decision touching ``model`` (diagnose's 'last
+        arbiter decision' column; None before the first)."""
+        for d in reversed(self.decisions):
+            if d["model"] == model:
+                return dict(d)
+        return None
+
+    def describe(self) -> Dict[str, Any]:
+        """Live budget + per-pool chips + recent decisions
+        (GET /state)."""
+        chips = {}
+        for name in list(self.entries):
+            try:
+                chips[name] = self.entries[name].pool.size \
+                    * self._cpr(name)
+            except KeyError:
+                continue
+        return {"budget": self.budget, "chips": chips,
+                "free": max(0, self.budget - sum(chips.values())),
+                "cooldown_s": self.policy.cooldown_s,
+                "decisions": self.decisions[-8:]}
+
+    def run_forever(self, stop: threading.Event) -> None:
+        while not stop.wait(self.policy.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # arbitration must never die quietly; the flight ring
+                # has the event, the next tick retries
+                telemetry.flight().record("fleet", "arbiter_error")

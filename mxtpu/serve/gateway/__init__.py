@@ -31,13 +31,14 @@ from .autoscale import AutoscalePolicy, Autoscaler
 from .disagg import (CircuitBreaker, DisaggBackend, KVChannel,
                      PrefillWorker)
 from .frontdoor import GatewayClient
-from .gateway import (Gateway, GatewayOverloaded, GatewayUnavailable,
-                      RequestHandle)
-from .replica import (EngineReplica, NoHealthyReplicas, ReplicaSet,
-                      ReplicaSupervisor, Ticket)
+from .gateway import (PRIORITIES, Gateway, GatewayOverloaded,
+                      GatewayUnavailable, RequestHandle)
+from .replica import (EngineReplica, GatewayClosed, NoHealthyReplicas,
+                      ReplicaSet, ReplicaSupervisor, Ticket)
 
 __all__ = ["Gateway", "GatewayOverloaded", "GatewayUnavailable",
-           "RequestHandle", "GatewayClient", "EngineReplica",
-           "ReplicaSet", "ReplicaSupervisor", "NoHealthyReplicas",
-           "Ticket", "DisaggBackend", "KVChannel", "PrefillWorker",
-           "CircuitBreaker", "AutoscalePolicy", "Autoscaler"]
+           "GatewayClosed", "RequestHandle", "GatewayClient",
+           "EngineReplica", "ReplicaSet", "ReplicaSupervisor",
+           "NoHealthyReplicas", "Ticket", "DisaggBackend",
+           "KVChannel", "PrefillWorker", "CircuitBreaker",
+           "AutoscalePolicy", "Autoscaler", "PRIORITIES"]

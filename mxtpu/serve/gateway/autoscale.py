@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional
 
 from ... import telemetry
 from ...telemetry.registry import interval_percentile
+from .replica import GatewayClosed
 
 __all__ = ["AutoscalePolicy", "Autoscaler", "interval_p99"]
 
@@ -149,7 +150,12 @@ class Autoscaler:
             return None
 
         new_n = n + (1 if direction == "up" else -1)
-        self.pool.scale_to(new_n)
+        try:
+            self.pool.scale_to(new_n)
+        except GatewayClosed:
+            # a late tick racing close(): the pool refused loudly
+            # (uniform close semantics) — stand down, count nothing
+            return None
         self._last_scale = now
         self._idle_since = None
         self._count_event(direction)

@@ -48,6 +48,19 @@ class _Handler(BaseHTTPRequestHandler):
     def gw(self) -> Gateway:
         return self.server.gateway     # type: ignore[attr-defined]
 
+    @staticmethod
+    def _build_fields(handle) -> Dict[str, Any]:
+        """Fleet provenance on every response: which model served it,
+        and which BUILD — across a hot-swap, the version label is how
+        a client (or the bench's bit-identity check) knows whether
+        old or new weights produced these tokens. Absent for
+        single-model deployments (responses unchanged)."""
+        out: Dict[str, Any] = {}
+        if getattr(handle, "model", None) is not None:
+            out["model"] = handle.model
+            out["version"] = handle.version
+        return out
+
     def _json(self, code: int, obj: Dict[str, Any],
               headers: Dict[str, str] = ()) -> None:
         body = json.dumps(obj).encode()
@@ -123,7 +136,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._json(200, {"tokens": [int(t) for t in toks],
                              "reason": handle.reason,
-                             "trace_id": handle.trace_id})
+                             "trace_id": handle.trace_id,
+                             **self._build_fields(handle)})
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
@@ -136,7 +150,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(json.dumps(
                 {"done": True, "reason": handle.reason,
                  "tokens": handle.tokens,
-                 "trace_id": handle.trace_id}).encode() + b"\n")
+                 "trace_id": handle.trace_id,
+                 **self._build_fields(handle)}).encode() + b"\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             # the slow-client story: a dead consumer must not hold a
@@ -241,15 +256,22 @@ class GatewayClient:
                     rec["retry_after_s"] = int(headers["retry-after"])
                 return rec
             trace_id = None
+            model = version = None
             for line in f:
                 evt = json.loads(line)
                 if evt.get("done"):
                     reason = evt.get("reason")
                     tokens = [int(t) for t in evt["tokens"]]
                     trace_id = evt.get("trace_id")
+                    model = evt.get("model")
+                    version = evt.get("version")
                     break
                 times.append(time.perf_counter())
                 tokens.append(int(evt["token"]))
-        return {"status": status, "t0": t0, "tokens": tokens,
-                "times": times[:len(tokens)], "reason": reason,
-                "trace_id": trace_id}
+        rec = {"status": status, "t0": t0, "tokens": tokens,
+               "times": times[:len(tokens)], "reason": reason,
+               "trace_id": trace_id}
+        if model is not None:
+            rec["model"] = model
+            rec["version"] = version
+        return rec

@@ -50,33 +50,49 @@ from ... import telemetry
 from ...base import env_float, env_int, env_str
 from ...telemetry import distributed as dtrace
 from ..engine import Request, ServeEngine, cancel_counter, resume_key
-from .replica import (NoHealthyReplicas, ReplicaSet, ReplicaSupervisor,
-                      Ticket)
+from .replica import (GatewayClosed, NoHealthyReplicas, ReplicaSet,
+                      ReplicaSupervisor, Ticket)
 
 __all__ = ["Gateway", "GatewayOverloaded", "GatewayUnavailable",
-           "RequestHandle"]
+           "GatewayClosed", "RequestHandle", "PRIORITIES"]
 
 _DONE = object()     # stream sentinel
+
+# admission priority classes, strongest first: `interactive` gets the
+# full queue bound; `batch` and `offline` get shrinking fractions of
+# it AND are shed outright while the SLO burn rate is over threshold
+# (low-priority work yields first — the fleet arbiter then has burn
+# headroom to move chips instead of every class degrading together)
+PRIORITIES = ("interactive", "batch", "offline")
 
 
 class GatewayOverloaded(RuntimeError):
     """Admission refused: the gateway queue is at its bound (or the
     request's own deadline cannot survive the current backlog — the
-    tier-1 deadline-aware shed). Carries the ``retry_after`` hint
-    (seconds, jittered) the front door sends back."""
+    tier-1 deadline-aware shed, or the request's priority class is
+    yielding under SLO burn — tier 3). Carries the ``retry_after``
+    hint (seconds, jittered) the front door sends back."""
 
     def __init__(self, depth: int, bound: int, retry_after: int,
-                 tier: int = 2):
-        super().__init__(
-            (f"gateway queue full ({depth} >= {bound}); "
-             f"retry in ~{retry_after}s") if tier == 2 else
-            (f"gateway backlog ({depth}/{bound}) outlives the "
-             f"request's deadline budget (tier-1 shed); "
-             f"retry in ~{retry_after}s"))
+                 tier: int = 2, priority: str = "interactive"):
+        if tier == 3:
+            msg = (f"gateway shedding {priority} traffic under SLO "
+                   f"burn; retry in ~{retry_after}s")
+        elif tier == 2:
+            msg = (f"gateway queue full ({depth} >= {bound}"
+                   + (f", {priority} bound" if priority
+                      != "interactive" else "")
+                   + f"); retry in ~{retry_after}s")
+        else:
+            msg = (f"gateway backlog ({depth}/{bound}) outlives the "
+                   f"request's deadline budget (tier-1 shed); "
+                   f"retry in ~{retry_after}s")
+        super().__init__(msg)
         self.depth = depth
         self.bound = bound
         self.retry_after = retry_after
         self.tier = tier
+        self.priority = priority
 
 
 class GatewayUnavailable(RuntimeError):
@@ -139,7 +155,22 @@ class RequestHandle:
         self.reason: Optional[str] = None
         self.ticket: Optional[Ticket] = None
         self.trace_id: Optional[str] = None
+        self.model: Optional[str] = None
         self._entry: Optional[_JournalEntry] = None
+
+    @property
+    def version(self) -> Optional[str]:
+        """Model-build tag of the replica CURRENTLY carrying the
+        request (None outside a fleet pool). Read at response time it
+        names the build that produced the final tokens — across a hot
+        swap, requests that completed on the old build report the old
+        version, the seam an operator greps for."""
+        ticket = self.ticket
+        rep = getattr(ticket, "replica", None)
+        if rep is None:
+            rep = getattr(getattr(ticket, "seated", None),
+                          "replica", None)
+        return getattr(rep, "version", None)
 
     # engine-side callbacks (never block: queue puts + list appends)
     def _on_token(self, rid: int, token: int) -> None:
@@ -214,10 +245,20 @@ class Gateway:
                  supervisor_opts: Optional[Dict[str, Any]] = None,
                  retry_jitter: Optional[float] = None,
                  federate=None,
+                 model: Optional[str] = None,
+                 slo: Optional[Dict[str, float]] = None,
                  clock: Optional[Callable[[], float]] = None):
         if (backend is None) == (engine_factory is None):
             raise ValueError(
                 "pass exactly one of engine_factory / backend")
+        # `model`: this gateway serves ONE named model of a fleet —
+        # its request counters, TTFT histogram and SLO gauges carry a
+        # model=<name> label so two models' series coexist in one
+        # registry. None (the single-model deployment) keeps every
+        # series name AND label set exactly as before: existing
+        # scrapes are grandfathered.
+        self.model = model
+        self._mlabels = {"model": model} if model else {}
         if backend is None:
             backend = ReplicaSet(
                 engine_factory,
@@ -270,14 +311,38 @@ class Gateway:
         self._m_requests: Dict[str, Any] = {}
         self._m_depth = telemetry.gauge(
             "gateway_queue_depth",
-            "Requests accepted by the gateway, not yet seated")
+            "Requests accepted by the gateway, not yet seated",
+            **self._mlabels)
         self._m_ttft = telemetry.histogram(
             "gateway_ttft_ms",
-            "Time to first token, submission to first on_token")
+            "Time to first token, submission to first on_token",
+            **self._mlabels)
         self._m_redispatch = telemetry.counter(
             "gateway_redispatch_total",
             "In-flight requests moved off a failed replica and "
-            "resumed on a healthy one")
+            "resumed on a healthy one", **self._mlabels)
+        self._m_shed: Dict[tuple, Any] = {}
+        # accepted-by-priority tally (plain ints under _lock): the
+        # /state "priority mix" a fleet diagnose renders per model
+        self.priority_tally: Dict[str, int] = {p: 0
+                                               for p in PRIORITIES}
+        # priority-class admission: batch/offline get a FRACTION of
+        # the queue bound and are shed outright under SLO burn
+        self._batch_frac = env_float(
+            "MXTPU_FLEET_BATCH_QUEUE_FRAC", 0.5,
+            "Fraction of the gateway queue bound available to "
+            "priority=batch requests (interactive always gets the "
+            "full bound, so batch is shed first as backlog builds).")
+        self._offline_frac = env_float(
+            "MXTPU_FLEET_OFFLINE_QUEUE_FRAC", 0.25,
+            "Fraction of the gateway queue bound available to "
+            "priority=offline requests (shed before batch).")
+        self._burn_shed = bool(env_int(
+            "MXTPU_FLEET_BURN_SHED", 1,
+            "Shed batch/offline submissions outright while any SLO "
+            "burn rate is over threshold (tier-3 shed: low-priority "
+            "work yields chips to interactive under burn); 0 "
+            "disables."))
         # metrics federation: peer processes (prefill workers on
         # other hosts, a kvstore server, sibling replicas) exposing
         # their registry via telemetry.RegistryServer; this gateway's
@@ -291,8 +356,24 @@ class Gateway:
                 "process=<role>, plus exact aggregate series).")
         self._federate = self._parse_peers(federate)
         self._fed_secret = env_str("MXTPU_GATEWAY_SECRET", "").encode()
-        # derived SLO gauges + burn rate (None unless a target is set)
-        self.slo = dtrace.SLOTracker.from_env(clock=self._clock)
+        # derived SLO gauges + burn rate (None unless a target is
+        # set). `slo=` (dict: ttft_ms/token_ms/burn/window_s) sets
+        # explicit per-gateway targets — the fleet's per-model path,
+        # where one process holds many trackers and the env singleton
+        # cannot express them; absent, the env knobs apply as before.
+        # Either way the tracker reads THIS gateway's (possibly
+        # model-labeled) TTFT histogram and labels its gauges to
+        # match, so per-model burn rates never collide.
+        if slo is not None:
+            self.slo = dtrace.SLOTracker.from_spec(
+                slo, clock=self._clock,
+                instruments={"ttft": self._m_ttft},
+                labels=self._mlabels)
+        else:
+            self.slo = dtrace.SLOTracker.from_env(
+                clock=self._clock,
+                instruments={"ttft": self._m_ttft},
+                labels=self._mlabels)
         self._http = None
         self._scaler = None
         self._scaler_stop: Optional[threading.Event] = None
@@ -334,15 +415,20 @@ class Gateway:
         return peers
 
     @staticmethod
-    def _ticket_replica_name(ticket) -> Optional[str]:
-        """Best-effort replica name behind a ticket (colocated Ticket
-        or a seated disagg ticket) — the redispatch span's old/new
-        endpoints."""
+    def _ticket_replica(ticket):
+        """Best-effort replica object behind a ticket (colocated
+        Ticket or a seated disagg ticket)."""
         rep = getattr(ticket, "replica", None)
         if rep is None:
             rep = getattr(getattr(ticket, "seated", None),
                           "replica", None)
-        return getattr(rep, "name", None)
+        return rep
+
+    @classmethod
+    def _ticket_replica_name(cls, ticket) -> Optional[str]:
+        """Best-effort replica name behind a ticket — the redispatch
+        span's old/new endpoints."""
+        return getattr(cls._ticket_replica(ticket), "name", None)
 
     def _count(self, code: str) -> None:
         m = self._m_requests.get(code)
@@ -350,7 +436,19 @@ class Gateway:
             m = self._m_requests[code] = telemetry.counter(
                 "gateway_requests_total",
                 "Requests at the gateway front door, by outcome code",
-                code=code)
+                code=code, **self._mlabels)
+        m.inc()
+
+    def _count_shed(self, priority: str, tier: int) -> None:
+        key = (priority, tier)
+        m = self._m_shed.get(key)
+        if m is None:
+            m = self._m_shed[key] = telemetry.counter(
+                "gateway_shed_total",
+                "Admission refusals, by priority class and shed tier "
+                "(1 = deadline-aware, 2 = queue bound, 3 = priority "
+                "yield under SLO burn)",
+                priority=priority, tier=str(tier), **self._mlabels)
         m.inc()
 
     def _retry_after(self, base: int) -> int:
@@ -369,19 +467,34 @@ class Gateway:
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None, seed: int = 0,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> RequestHandle:
+               trace_id: Optional[str] = None,
+               priority: str = "interactive",
+               prefer_replica: Optional[str] = None) -> RequestHandle:
         """Admission-check + journal + route; returns the streaming
         handle. Raises :class:`GatewayOverloaded` past the queue bound
-        (or the tier-1 deadline shed), :class:`GatewayUnavailable`
-        when no healthy replica exists, and ``ValueError`` on invalid
-        parameters (the front door maps these to 429 / 503 / 400).
+        (or the tier-1 deadline shed, or a tier-3 priority yield),
+        :class:`GatewayUnavailable` when no healthy replica exists,
+        and ``ValueError`` on invalid parameters (the front door maps
+        these to 429 / 503 / 400).
         ``trace_id`` (plausible hex, e.g. an upstream proxy's) is
         honored; otherwise a fresh trace is minted — either way the
         request carries ONE :class:`~mxtpu.telemetry.TraceContext`
         across every hop of its life, crash re-dispatch included
         (``handle.trace_id`` is the key ``tools/diagnose.py
-        timeline`` stitches on)."""
+        timeline`` stitches on).
+
+        ``priority`` (one of :data:`PRIORITIES`): batch/offline see a
+        fraction of the queue bound and are shed outright under SLO
+        burn — tokens, once admitted, are served identically; the
+        class only changes who is REFUSED first. ``prefer_replica``:
+        session affinity — land on this replica if it is still
+        healthy (fleet router sets it from the session map)."""
+        if priority not in PRIORITIES:
+            self._count("400")
+            raise ValueError(f"unknown priority {priority!r}; "
+                             f"known: {PRIORITIES}")
         handle = RequestHandle(self, time.perf_counter())
+        handle.model = self.model
         deadline = (deadline_s if deadline_s is not None
                     else self.default_deadline_s)
         # ONE critical section from depth check to enqueue: every
@@ -393,14 +506,36 @@ class Gateway:
             depth = load["queued"]
             self._m_depth.set(depth)
             drain = max(1, round(depth / max(1, load["slots"])))
-            if depth >= self.queue_max:
+            bound = self.queue_max
+            if priority != "interactive":
+                if (self._burn_shed and self.slo is not None
+                        and self.slo.breached):
+                    # tier 3: the SLO is burning — low-priority work
+                    # yields NOW so interactive latency recovers (and
+                    # the fleet arbiter sees honest interactive
+                    # pressure, not a backlog batch inflated)
+                    retry = self._retry_after(max(drain, 2))
+                    self._count("429")
+                    self._count_shed(priority, 3)
+                    telemetry.flight().record(
+                        "gateway", "shed", depth=depth, tier=3,
+                        priority=priority, model=self.model)
+                    raise GatewayOverloaded(depth, bound, retry,
+                                            tier=3, priority=priority)
+                frac = (self._batch_frac if priority == "batch"
+                        else self._offline_frac)
+                bound = max(1, int(round(self.queue_max * frac)))
+            if depth >= bound:
                 retry = self._retry_after(drain)
                 self._count("429")
+                self._count_shed(priority, 2)
                 telemetry.flight().record("gateway", "shed",
                                           depth=depth, tier=2,
-                                          bound=self.queue_max)
-                raise GatewayOverloaded(depth, self.queue_max, retry,
-                                        tier=2)
+                                          bound=bound,
+                                          priority=priority,
+                                          model=self.model)
+                raise GatewayOverloaded(depth, bound, retry,
+                                        tier=2, priority=priority)
             if (self.shed_soft < 1.0
                     and depth >= self.shed_soft * self.queue_max
                     and deadline is not None and deadline < drain):
@@ -409,11 +544,14 @@ class Gateway:
                 # client will never wait for
                 retry = self._retry_after(drain)
                 self._count("429")
+                self._count_shed(priority, 1)
                 telemetry.flight().record("gateway", "shed",
                                           depth=depth, tier=1,
-                                          deadline_s=deadline)
+                                          deadline_s=deadline,
+                                          priority=priority,
+                                          model=self.model)
                 raise GatewayOverloaded(depth, self.queue_max, retry,
-                                        tier=1)
+                                        tier=1, priority=priority)
             with self._jlock:
                 self._gid += 1
                 entry = _JournalEntry(
@@ -436,12 +574,19 @@ class Gateway:
                 handle._entry = entry
                 self._journal[entry.gid] = entry
             req = self._build_request(entry, deadline_s=deadline)
+            # affinity only applies to ReplicaSet-style backends (a
+            # disagg backend's route has no prefer surface); passed
+            # conditionally so other backends need no signature change
+            route_kw = ({"prefer": prefer_replica}
+                        if prefer_replica is not None
+                        and isinstance(self.backend, ReplicaSet)
+                        else {})
             try:
                 with dtrace.use(entry.ctx), telemetry.span(
                         "gateway.submit",
                         prompt_len=int(entry.prompt.size),
                         max_new_tokens=int(max_new_tokens)):
-                    ticket = self.backend.route(req)
+                    ticket = self.backend.route(req, **route_kw)
             except NoHealthyReplicas as e:
                 with self._jlock:
                     self._journal.pop(entry.gid, None)
@@ -464,6 +609,7 @@ class Gateway:
             with self._jlock:
                 entry.ticket = ticket
             handle.ticket = ticket
+            self.priority_tally[priority] += 1
         self._count("accepted")
         return handle
 
@@ -511,10 +657,16 @@ class Gateway:
             deadline_s=deadline_s, ctx=entry.ctx)
 
     def submit_dict(self, body: Dict[str, Any],
-                    trace_id: Optional[str] = None) -> RequestHandle:
+                    trace_id: Optional[str] = None,
+                    prefer_replica: Optional[str] = None
+                    ) -> RequestHandle:
         """The front door's JSON surface: validates types, forwards
         known fields. ``trace_id`` joins an upstream trace (the
-        ``X-Mxtpu-Trace`` header or the body's ``trace_id`` field)."""
+        ``X-Mxtpu-Trace`` header or the body's ``trace_id`` field).
+        ``model``/``session_id`` in the body are the FLEET router's
+        fields — a per-model gateway reached directly ignores them
+        (the fleet resolves them into this call's target and
+        ``prefer_replica`` before delegating here)."""
         if not isinstance(body, dict):
             raise ValueError("body must be a JSON object")
         if "prompt" not in body:
@@ -530,7 +682,9 @@ class Gateway:
             top_k=body.get("top_k"), top_p=body.get("top_p"),
             seed=int(body.get("seed", 0)),
             deadline_s=body.get("deadline_s"),
-            trace_id=trace_id or body.get("trace_id"))
+            trace_id=trace_id or body.get("trace_id"),
+            priority=str(body.get("priority", "interactive")),
+            prefer_replica=prefer_replica)
 
     # -- fault recovery ------------------------------------------------------
     def _cancel_entry(self, entry: _JournalEntry,
@@ -589,8 +743,14 @@ class Gateway:
                     entry.epoch += 1
                     emitted = list(entry.handle.tokens)
                     deadline_abs = entry.deadline_abs
-                    old_replica = self._ticket_replica_name(
-                        entry.ticket)
+                    old_rep = self._ticket_replica(entry.ticket)
+                    old_replica = getattr(old_rep, "name", None)
+                    # a request accepted on one model BUILD must
+                    # resume on the same build or its tokens diverge
+                    # from the fault-free run: mid-hot-swap, route is
+                    # constrained to same-version replicas (fleet
+                    # pools; None — every plain set — is unrestricted)
+                    old_version = getattr(old_rep, "version", None)
                     if entry.ctx is not None:
                         # SAME trace, new segment: the resumed hops
                         # parent to the redispatch, not the original
@@ -629,11 +789,15 @@ class Gateway:
                 # the explicit crash seam in the request's ONE trace:
                 # a `gateway.redispatch` span naming the replica the
                 # request died on and the one it resumes on
+                route_kw = ({"version": old_version}
+                            if old_version is not None
+                            and isinstance(self.backend, ReplicaSet)
+                            else {})
                 with dtrace.use(entry.ctx), telemetry.span(
                         "gateway.redispatch",
                         old_replica=old_replica,
                         emitted=len(emitted)) as rd_span:
-                    ticket = self.backend.route(req)
+                    ticket = self.backend.route(req, **route_kw)
                     rd_span.args["new_replica"] = \
                         self._ticket_replica_name(ticket)
             except NoHealthyReplicas:
@@ -820,6 +984,8 @@ class Gateway:
         return {"replicas": replicas,
                 "kv_cache": kv_cache,
                 "n_replicas": self.backend.size,
+                "model": self.model,
+                "priority_mix": dict(self.priority_tally),
                 "queued": load["queued"], "active": load["active"],
                 "slots": load["slots"], "queue_max": self.queue_max,
                 "health": self._health(load, breaker, sup),

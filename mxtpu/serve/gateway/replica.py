@@ -48,15 +48,27 @@ from ...base import env_float, env_int
 from ..engine import KVHandoff, Request, ServeEngine
 
 __all__ = ["EngineReplica", "ReplicaSet", "ReplicaSupervisor",
-           "Ticket", "NoHealthyReplicas"]
+           "Ticket", "NoHealthyReplicas", "GatewayClosed"]
 
 
 class NoHealthyReplicas(RuntimeError):
     """``route`` found no live replica to carry the request (all dead
     or removed, restart budget exhausted, or the set is empty). The
     front door maps this to 503 + ``Retry-After`` — distinct from
-    queue overload (429) and from a closed set (plain RuntimeError):
-    the client should retry later, not slower."""
+    queue overload (429) and from a closed set
+    (:class:`GatewayClosed`): the client should retry later, not
+    slower."""
+
+
+class GatewayClosed(RuntimeError):
+    """The pool has been ``close()``d: every mutating surface
+    (``route``, ``scale_to``, ``drain_replica``) raises this — one
+    consistent refusal instead of the old mix of a plain RuntimeError
+    on route and a silent no-op on scale_to. Subclasses RuntimeError
+    so callers that already caught the closed-set RuntimeError (the
+    gateway's submit path, supervisor races) keep working unchanged;
+    loops that tick on a timer (autoscaler, fleet arbiter) catch it
+    by name and stand down."""
 
 
 class Ticket:
@@ -97,6 +109,10 @@ class EngineReplica:
         engine.role = name
         self.name = name
         self.failed = False
+        # model-build tag (fleet pools stamp this at spawn; the
+        # response's `version` field and version-aware re-dispatch
+        # read it). None for plain single-build sets.
+        self.version: Optional[str] = None
         self.failure: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -173,7 +189,9 @@ class ReplicaSet:
     remove/spawn surface the supervisor drives."""
 
     def __init__(self, engine_factory: Callable[[], ServeEngine],
-                 n_replicas: int = 1, *, started: bool = True):
+                 n_replicas: int = 1, *, started: bool = True,
+                 name_prefix: str = "r",
+                 labels: Optional[Dict[str, str]] = None):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
         self._factory = engine_factory
@@ -183,10 +201,25 @@ class ReplicaSet:
         self._draining: List[EngineReplica] = []
         self._seq = itertools.count()
         self._started = started
+        # fleet pools prefix with the model name so federated scrapes
+        # and /state rows are attributable without a join; `labels`
+        # (e.g. model=<name>) keeps two pools' replica gauges from
+        # last-write-clobbering each other in one registry
+        self._name_prefix = name_prefix
         self._m_replicas = telemetry.gauge(
             "gateway_replicas", "Live engine replicas behind the "
-            "gateway router")
+            "gateway router", **dict(labels or {}))
         self.scale_to(n_replicas)
+
+    def _new_replica(self) -> EngineReplica:
+        """Build (never start/register) one replica from the factory —
+        the ONE construction point ``scale_to`` and ``spawn_replica``
+        share, so a subclass stamping per-build metadata (fleet pools
+        set ``.version``) covers every spawn path. Called under
+        ``_lock``."""
+        return EngineReplica(
+            self._factory(),
+            name=f"{self._name_prefix}{next(self._seq)}")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -212,27 +245,62 @@ class ReplicaSet:
 
     # -- routing -----------------------------------------------------------
     def route(self, req: Request,
-              handoff: Optional[KVHandoff] = None) -> Ticket:
+              handoff: Optional[KVHandoff] = None, *,
+              prefer: Optional[str] = None,
+              version: Optional[str] = None) -> Ticket:
         """Submit to the least-loaded healthy replica. Raises
-        RuntimeError after ``close()`` and :class:`NoHealthyReplicas`
-        when every replica is dead/removed. Pick + submit are ONE
-        critical section: concurrent routes must see each other's
-        submissions (two racing requests both reading queued=0 would
-        pile onto the same replica), and a route racing close() must
-        never hand a request to a replica nothing will serve."""
+        :class:`GatewayClosed` after ``close()`` and
+        :class:`NoHealthyReplicas` when every replica is
+        dead/removed. Pick + submit are ONE critical section:
+        concurrent routes must see each other's submissions (two
+        racing requests both reading queued=0 would pile onto the
+        same replica), and a route racing close() must never hand a
+        request to a replica nothing will serve.
+
+        ``prefer``: a replica NAME — session affinity. When that
+        replica is still healthy the request lands on it regardless
+        of load (the session's KV-warm replica beats a cold
+        least-loaded one); gone or draining, routing falls back to
+        least-loaded silently.
+
+        ``version``: restrict to replicas of one model build —
+        crash re-dispatch during a hot-swap uses it so a request
+        accepted on the old build resumes on the old build
+        (bit-identity). Best-effort: when NO healthy replica of that
+        version survives, all healthy replicas are eligible (the
+        response's version label shows the seam)."""
         with self._lock:
             if self._closed:
-                raise RuntimeError("replica set is closed")
+                raise GatewayClosed("replica set is closed")
             live = [r for r in self._replicas if r.healthy]
             if not live:
                 raise NoHealthyReplicas(
                     f"no healthy replica to route to "
                     f"({len(self._replicas)} registered)")
-            loads = [(r, r.load()) for r in live]
-            replica, _ = min(
-                loads, key=lambda rl: (rl[1]["queued"]
-                                       + rl[1]["active"])
-                / max(1, rl[1]["slots"]))
+            if version is not None:
+                same = [r for r in live if r.version == version]
+                if not same:
+                    # old-build resume mid-swap with every same-build
+                    # replica already DRAINING: a draining replica
+                    # still serves work submitted before it goes idle
+                    # (the engine loop exits only at stop+empty), so
+                    # extend one drain rather than resume on the new
+                    # build and break bit-identity
+                    same = [r for r in self._draining
+                            if r.version == version and r.alive
+                            and not r.failed]
+                if same:
+                    live = same
+            replica = None
+            if prefer is not None:
+                replica = next((r for r in live if r.name == prefer),
+                               None)
+            if replica is None:
+                loads = [(r, r.load()) for r in live]
+                replica, _ = min(
+                    loads, key=lambda rl: (rl[1]["queued"]
+                                           + rl[1]["active"])
+                    / max(1, rl[1]["slots"]))
             rid = (replica.submit(req) if handoff is None
                    else replica.submit_prefilled(handoff, req))
         return Ticket(replica, rid)
@@ -262,12 +330,13 @@ class ReplicaSet:
 
     def spawn_replica(self) -> Optional[EngineReplica]:
         """Start one fresh replica from the factory and add it to
-        routing (the supervisor's restart lever). None after close."""
+        routing (the supervisor's restart lever). None after close —
+        a supervisor heartbeat racing shutdown is benign, so this one
+        surface stays a quiet refusal rather than raising."""
         with self._lock:
             if self._closed:
                 return None
-            r = EngineReplica(self._factory(),
-                              name=f"r{next(self._seq)}")
+            r = self._new_replica()
             if self._started:
                 r.start()
             self._replicas.append(r)
@@ -275,25 +344,60 @@ class ReplicaSet:
         self._m_replicas.set(live)
         return r
 
+    def drain_replica(self, replica: EngineReplica) -> bool:
+        """Pull a HEALTHY replica out of routing and let it finish
+        every accepted request before its thread exits — the hot-swap
+        retirement path. Unlike the supervisor's ``remove_replica``
+        (crash path: marks the replica failed so its tickets read
+        dead and re-dispatch), a drained replica stays healthy to the
+        requests it already holds; it just takes no new ones. The
+        drained replica joins ``_draining`` so ``close()`` still
+        joins its thread. Raises :class:`GatewayClosed` after
+        close(); returns False when the replica was not in the
+        routing set (already drained/removed)."""
+        with self._lock:
+            if self._closed:
+                raise GatewayClosed("replica set is closed")
+            if replica not in self._replicas:
+                return False
+            self._replicas.remove(replica)
+            self._draining.append(replica)
+            live = len(self._replicas)
+        replica.stop()
+        self._m_replicas.set(live)
+        return True
+
     # -- autoscaler surface ------------------------------------------------
     @property
     def size(self) -> int:
         with self._lock:
             return len(self._replicas)
 
+    def set_factory(self, engine_factory: Callable[[], ServeEngine],
+                    version: Optional[str] = None) -> None:
+        """Swap the engine factory every FUTURE spawn uses (hot-swap:
+        the new build's factory goes in first, then old replicas are
+        drained one by one). Existing replicas are untouched."""
+        with self._lock:
+            if self._closed:
+                raise GatewayClosed("replica set is closed")
+            self._factory = engine_factory
+            if version is not None:
+                self.version = version
+
     def scale_to(self, n: int) -> int:
         """Grow/shrink to ``n`` live replicas (floor 1). Shrinking
         moves replicas to the draining list — out of routing
-        immediately, threads exit once their accepted work is done."""
+        immediately, threads exit once their accepted work is done.
+        Raises :class:`GatewayClosed` after ``close()`` — scaling a
+        closed pool used to return 0 silently, leaving a late
+        autoscaler/arbiter believing it had capacity it did not."""
         n = max(1, int(n))
         with self._lock:
             if self._closed:
-                # a late autoscaler tick racing close() must never
-                # resurrect replicas nothing will ever stop
-                return 0
+                raise GatewayClosed("replica set is closed")
             while len(self._replicas) < n:
-                r = EngineReplica(self._factory(),
-                                  name=f"r{next(self._seq)}")
+                r = self._new_replica()
                 if self._started:
                     r.start()
                 self._replicas.append(r)
@@ -319,7 +423,7 @@ class ReplicaSet:
 
     def state(self) -> List[Dict[str, Any]]:
         return [dict(name=r.name, alive=r.alive, healthy=r.healthy,
-                     failed=r.failed,
+                     failed=r.failed, version=r.version,
                      error=(repr(r.failure)[:120] if r.failure
                             else None), steps=r.engine.steps_run,
                      kv_cache=r.engine.kv_cache_stats(),

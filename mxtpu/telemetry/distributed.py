@@ -592,7 +592,9 @@ class SLOTracker:
     def __init__(self, targets: Dict[str, float], *, q: float = 99.0,
                  burn_threshold: float = 1.0, window_s: float = 10.0,
                  registry: Optional[MetricsRegistry] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 instruments: Optional[Dict[str, Any]] = None,
+                 labels: Optional[Dict[str, str]] = None):
         unknown = set(targets) - set(self.METRICS)
         if unknown:
             raise ValueError(f"unknown SLOs {sorted(unknown)}; "
@@ -608,25 +610,61 @@ class SLOTracker:
         self._prev: Dict[str, List[int]] = {}
         self._last_tick: Optional[float] = None
         self._last: Dict[str, Dict[str, Optional[float]]] = {}
+        # per-model gateways hand their OWN histogram children here
+        # (e.g. gateway_ttft_ms{model=...}) instead of the registry's
+        # unlabeled default, and label the derived gauges to match —
+        # two models' trackers then coexist in one registry without
+        # clobbering each other's gateway_slo_* series
+        self._instruments = dict(instruments or {})
+        labels = dict(labels or {})
         import mxtpu.telemetry as _tm
         self._g_p99 = {s: _tm.gauge(
             "gateway_slo_p99_ms",
             "Interval p99 of the SLO's latency histogram since the "
-            "last SLO window tick", slo=s) for s in self.targets}
+            "last SLO window tick", slo=s, **labels)
+            for s in self.targets}
         self._g_target = {s: _tm.gauge(
             "gateway_slo_target_ms", "Configured SLO latency target",
-            slo=s) for s in self.targets}
+            slo=s, **labels) for s in self.targets}
         self._g_burn = {s: _tm.gauge(
             "gateway_slo_burn_rate",
             "Fraction of the window's observations over target, "
             "divided by the error budget (1 - q/100); > 1 burns "
-            "budget faster than allowed", slo=s)
+            "budget faster than allowed", slo=s, **labels)
             for s in self.targets}
         for s, t in self.targets.items():
             self._g_target[s].set(t)
 
     @classmethod
-    def from_env(cls, clock: Optional[Callable[[], float]] = None
+    def from_spec(cls, spec: Dict[str, float], *,
+                  clock: Optional[Callable[[], float]] = None,
+                  instruments: Optional[Dict[str, Any]] = None,
+                  labels: Optional[Dict[str, str]] = None
+                  ) -> Optional["SLOTracker"]:
+        """Explicit-targets constructor (per-model SLOs in a fleet —
+        one process, many trackers, so the env singleton does not
+        fit): ``{"ttft_ms": 200, "token_ms": 50, "burn": 1.0,
+        "window_s": 10}``, zero/absent targets disabled. None when no
+        target survives, mirroring :meth:`from_env`."""
+        spec = dict(spec or {})
+        targets = {k: v for k, v in
+                   (("ttft", float(spec.pop("ttft_ms", 0.0))),
+                    ("token", float(spec.pop("token_ms", 0.0))))
+                   if v > 0}
+        burn = float(spec.pop("burn", 1.0))
+        window = float(spec.pop("window_s", 10.0))
+        if spec:
+            raise ValueError(f"unknown SLO spec keys {sorted(spec)}")
+        if not targets:
+            return None
+        return cls(targets, burn_threshold=burn, window_s=window,
+                   clock=clock, instruments=instruments,
+                   labels=labels)
+
+    @classmethod
+    def from_env(cls, clock: Optional[Callable[[], float]] = None, *,
+                 instruments: Optional[Dict[str, Any]] = None,
+                 labels: Optional[Dict[str, str]] = None
                  ) -> Optional["SLOTracker"]:
         """The gateway's constructor path: None when no SLO target is
         configured (the tracker, its gauges and its /healthz input
@@ -654,7 +692,8 @@ class SLOTracker:
         if not targets:
             return None
         return cls(targets, burn_threshold=burn, window_s=window,
-                   clock=clock)
+                   clock=clock, instruments=instruments,
+                   labels=labels)
 
     def tick(self, force: bool = False) -> Dict[str, Dict[str, Any]]:
         """Advance the window if it is due (or ``force``) and return
@@ -668,7 +707,8 @@ class SLOTracker:
             self._last_tick = now
             out: Dict[str, Dict[str, Any]] = {}
             for slo, target in self.targets.items():
-                h = reg.get(self.METRICS[slo])
+                h = (self._instruments.get(slo)
+                     or reg.get(self.METRICS[slo]))
                 p99 = burn = None
                 if h is not None:
                     counts, _, _ = h.snapshot()

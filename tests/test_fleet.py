@@ -1,0 +1,496 @@
+"""Fleet control plane (ISSUE 15): multi-model, multi-tenant serving
+over the gateway — ``mxtpu.serve.fleet``.
+
+Tier-1 contract:
+
+- **named-model routing, bit-identical**: two models behind one front
+  door; every response's tokens match a per-request
+  ``llama.generate`` with THAT model's weights, and carry
+  model + build-version labels;
+- **chip arbitration**: one allocator on a fixed budget moves a
+  replica's worth of chips from a sustained-idle pool to a burning
+  one — hysteresis (cooldown, sustained idle) proven on a fake clock
+  with injected signals;
+- **priority classes**: batch/offline see a fraction of the queue
+  bound and are shed outright under SLO burn, interactive admitted
+  throughout — shed ORDER is the contract;
+- **live hot-swap**: weights replaced under load with zero accepted
+  requests dropped; old-build requests finish on the old build
+  (version-keyed bit-identity);
+- **session affinity**: a returning session lands on the replica that
+  served it, counted in ``fleet_session_affinity_total``;
+- **closed-pool semantics**: every mutating surface of a closed
+  :class:`ReplicaSet` raises :class:`GatewayClosed` (no silent
+  refusals), and the autoscaler absorbs it quietly.
+
+The multi-process swarm + chaos acceptance run is ``bench.py fleet``;
+the fresh-process smoke is ci/runtime_functions.sh::fleet_smoke.
+"""
+import gc
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from dataclasses import replace
+
+from mxtpu import telemetry
+from mxtpu.models import llama
+from mxtpu.serve import ServeEngine
+from mxtpu.serve.gateway import (Gateway, GatewayClient, GatewayClosed,
+                                 GatewayOverloaded, ReplicaSet)
+from mxtpu.serve.gateway.autoscale import (Autoscaler,
+                                           AutoscalePolicy)
+from mxtpu.serve.fleet import (ArbiterPolicy, FleetArbiter,
+                               FleetGateway, ModelSpec)
+
+SUP = dict(heartbeat_s=0.05, stall_s=30.0, backoff_base_s=0.01,
+           backoff_max_s=0.05)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
+                   remat=False, attn_impl="dense")
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def params_b(cfg):
+    return llama.init_params(cfg, jax.random.PRNGKey(1))
+
+
+def _reference(cfg, params, prompt, mnew, seed=0, temperature=0.0):
+    out = llama.generate(
+        cfg, params, jnp.asarray(prompt, jnp.int32)[None], mnew,
+        temperature=temperature, rng=jax.random.PRNGKey(seed))
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def _fac(cfg, params, **kw):
+    kw.setdefault("max_slots", 2)
+    # max_len 32, not 64: every ServeEngine compiles its own XLA CPU
+    # programs, and the tier-1 suite runs close enough to the CPU
+    # JIT's process-wide code capacity that oversized programs here
+    # can segfault LATER compiles in the run
+    kw.setdefault("max_len", 32)
+    kw.setdefault("min_bucket", 4)
+    return lambda params=params: ServeEngine(cfg, params, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _release_engines():
+    # free closed engines' compiled executables between tests — see
+    # the max_len note above
+    yield
+    gc.collect()
+
+
+# ---------------------------------------------------------------------------
+# named-model routing: bit-identity + provenance labels
+# ---------------------------------------------------------------------------
+def test_two_model_routing_bit_identical(cfg, params, params_b):
+    """One front door, two models: each request's tokens match a
+    per-request generate with the weights of the model it NAMED (and
+    the two outputs differ, or the router proved nothing). Responses
+    carry model + build version; per-model request counters appear
+    alongside the grandfathered unlabeled family."""
+    reg = telemetry.registry()
+    a0 = reg.value("gateway_requests_total", code="accepted",
+                   model="alpha")
+    fleet = FleetGateway(
+        [ModelSpec("alpha", _fac(cfg, params)),
+         ModelSpec("beta", _fac(cfg, params_b))], supervise=False)
+    try:
+        prompt = [1, 5, 9, 13]
+        ha = fleet.submit_dict({"model": "alpha", "prompt": prompt,
+                                "max_new_tokens": 6,
+                                "temperature": 0.8, "seed": 11})
+        hb = fleet.submit_dict({"model": "beta", "prompt": prompt,
+                                "max_new_tokens": 6,
+                                "temperature": 0.8, "seed": 11})
+        ta = list(ha.result(timeout=120))
+        tb = list(hb.result(timeout=120))
+        assert ta == _reference(cfg, params, prompt, 6, seed=11,
+                                temperature=0.8)
+        assert tb == _reference(cfg, params_b, prompt, 6, seed=11,
+                                temperature=0.8)
+        assert ta != tb
+        assert (ha.model, ha.version) == ("alpha", "v0")
+        assert hb.model == "beta"
+        # front-door provenance: the HTTP trailer carries the labels
+        port = fleet.start_http(port=0)
+        rec = GatewayClient("127.0.0.1", port).generate(
+            prompt, 4, seed=3, model="beta")
+        assert rec["status"] == 200, rec
+        assert (rec["model"], rec["version"]) == ("beta", "v0")
+        assert rec["tokens"] == _reference(cfg, params_b, prompt, 4,
+                                           seed=3)
+        # a fleet with >1 model refuses anonymous and unknown names
+        with pytest.raises(ValueError, match="missing 'model'"):
+            fleet.submit_dict({"prompt": prompt, "max_new_tokens": 2})
+        with pytest.raises(ValueError, match="unknown model"):
+            fleet.submit_dict({"model": "gamma", "prompt": prompt,
+                               "max_new_tokens": 2})
+        assert reg.value("gateway_requests_total", code="accepted",
+                         model="alpha") - a0 == 1
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# closed-pool semantics: loud, uniform, absorbed by the autoscaler
+# ---------------------------------------------------------------------------
+def test_closed_pool_raises_gateway_closed_uniformly(cfg, params):
+    """Every mutating surface of a closed ReplicaSet raises
+    GatewayClosed — scale_to's old silent ``return 0`` is gone — and
+    the autoscaler's tick absorbs the race with shutdown quietly."""
+    pool = ReplicaSet(_fac(cfg, params), 1, started=False)
+    pool.close()
+    with pytest.raises(GatewayClosed):
+        pool.scale_to(2)
+    with pytest.raises(GatewayClosed):
+        pool.route(object())
+    with pytest.raises(GatewayClosed):
+        pool.set_factory(_fac(cfg, params))
+    with pytest.raises(GatewayClosed):
+        pool.drain_replica(object())
+    # GatewayClosed subclasses RuntimeError: pre-existing catch sites
+    # (gateway.submit's shutdown race) keep working unchanged
+    assert issubclass(GatewayClosed, RuntimeError)
+
+    # an autoscaler tick racing close(): the hot signal forces a
+    # scale attempt, the pool refuses loudly, the tick absorbs it
+    scaler = Autoscaler(
+        pool, AutoscalePolicy(min_replicas=1, max_replicas=4,
+                              cooldown_s=0.0, target_p99_ms=10.0),
+        latency_p99=lambda: 100.0)
+    assert scaler.tick() is None     # no raise, no decision
+
+
+# ---------------------------------------------------------------------------
+# chip arbitration on a fake clock with injected signals
+# ---------------------------------------------------------------------------
+class _FakePool:
+    def __init__(self, size, lo=1, hi=4):
+        self.size = size
+        self.min_replicas = lo
+        self.max_replicas = hi
+        self.chips_per_replica = 1
+        self.calls = []
+
+    def scale_to(self, n):
+        self.calls.append(n)
+        self.size = n
+        return n
+
+
+class _FakeEntry:
+    def __init__(self, pool):
+        self.pool = pool
+        self.gateway = None
+
+
+def test_arbiter_moves_chip_from_idle_to_burning():
+    """The chip MOVE, deterministically: with the budget fully
+    allocated, the burning pool is granted a replica by shrinking the
+    sustained-idle donor — but only after the donor has been idle for
+    ``idle_s`` (one quiet tick must NOT donate), and not again inside
+    the cooldown. When nothing burns, one sustained-idle pool shrinks
+    back to the free budget."""
+    reg = telemetry.registry()
+    up0 = reg.value("fleet_scale_events_total", model="hotm",
+                    direction="up")
+    dn0 = reg.value("fleet_scale_events_total", model="coldm",
+                    direction="down")
+    entries = {"hotm": _FakeEntry(_FakePool(1, lo=1, hi=3)),
+               "coldm": _FakeEntry(_FakePool(2, lo=1, hi=3))}
+    sig = {"hotm": dict(pressure=4.0, occupancy=1.0, burn=2.5,
+                        queued=8.0),
+           "coldm": dict(pressure=0.0, occupancy=0.0, burn=0.0,
+                         queued=0.0)}
+    now = [0.0]
+    arb = FleetArbiter(
+        entries,
+        ArbiterPolicy(interval_s=0.1, cooldown_s=5.0,
+                      pressure_high=2.0, burn_high=1.0, idle_s=2.0),
+        clock=lambda: now[0],
+        signals=lambda n, e: dict(sig[n],
+                                  size=float(entries[n].pool.size)))
+    assert arb.budget == 3            # derived from the allocation
+
+    # t=0: coldm just went quiet — not SUSTAINED idle yet, so the hot
+    # pool finds no donor and no free chips: no decision
+    assert arb.tick() == []
+    assert entries["coldm"].pool.size == 2
+
+    # t=3: idle for 3s >= idle_s: donor yields, claimant granted
+    now[0] = 3.0
+    decisions = arb.tick()
+    assert [(d["model"], d["direction"], d["reason"])
+            for d in decisions] == [("coldm", "down", "yield->hotm"),
+                                    ("hotm", "up", "hot")]
+    assert entries["coldm"].pool.size == 1
+    assert entries["hotm"].pool.size == 2
+    assert reg.value("fleet_scale_events_total", model="hotm",
+                     direction="up") - up0 == 1
+    assert reg.value("fleet_scale_events_total", model="coldm",
+                     direction="down") - dn0 == 1
+    assert reg.value("fleet_chips_in_use", model="hotm") == 2
+    assert reg.value("fleet_chips_free") == 0
+
+    # t=4: still burning, but both pools are inside the cooldown —
+    # hysteresis holds the allocation
+    now[0] = 4.0
+    assert arb.tick() == []
+    assert entries["hotm"].pool.size == 2
+
+    # recovery: nothing burns; after sustained idle (and cooldown),
+    # ONE pool returns a replica's chips to the free budget
+    sig["hotm"].update(pressure=0.0, occupancy=0.0, burn=0.0,
+                       queued=0.0)
+    now[0] = 9.0                      # cooldown over; idle clock arms
+    assert arb.tick() == []
+    now[0] = 12.0                     # 3s sustained idle
+    decisions = arb.tick()
+    assert len(decisions) == 1 and decisions[0]["reason"] == "idle"
+    assert reg.value("fleet_chips_free") == 1
+    assert arb.last_decision("hotm")["direction"] in ("up", "down")
+    assert arb.describe()["budget"] == 3
+
+
+def test_arbiter_respects_bounds_and_min_floor():
+    """A donor at min_replicas never yields (sustained idle or not);
+    a claimant at max_replicas is never granted."""
+    entries = {"a": _FakeEntry(_FakePool(1, lo=1, hi=1)),
+               "b": _FakeEntry(_FakePool(1, lo=1, hi=3))}
+    sig = {"a": dict(pressure=9.0, occupancy=1.0, burn=9.0,
+                     queued=9.0),
+           "b": dict(pressure=0.0, occupancy=0.0, burn=0.0,
+                     queued=0.0)}
+    now = [100.0]
+    arb = FleetArbiter(
+        entries, ArbiterPolicy(cooldown_s=0.0, idle_s=0.0),
+        clock=lambda: now[0],
+        signals=lambda n, e: dict(sig[n],
+                                  size=float(entries[n].pool.size)))
+    # "a" burns but is at max (hi=1): no grant; "b" is at min: no
+    # donation either — the tick is a no-op, sizes hold
+    assert arb.tick() == []
+    assert (entries["a"].pool.size, entries["b"].pool.size) == (1, 1)
+
+
+# ---------------------------------------------------------------------------
+# priority classes: shed ORDER under pressure and burn
+# ---------------------------------------------------------------------------
+def test_priority_shed_ordering(cfg, params):
+    """Against a stalled pool (replicas never started, so admission
+    arithmetic is exact): offline is refused first (25% of the
+    bound), then batch (50%), interactive admitted to the full bound;
+    under synthetic SLO burn, batch is shed OUTRIGHT (tier 3) while
+    interactive still lands."""
+    reg = telemetry.registry()
+    shed0 = {(p, t): reg.value("gateway_shed_total", priority=p,
+                               tier=t, model="m")
+             for p in ("batch", "offline") for t in ("2", "3")}
+    pool = ReplicaSet(_fac(cfg, params), 1, started=False)
+    gw = Gateway(backend=pool, model="m", queue_max=8,
+                 slo={"ttft_ms": 10.0}, supervise=False)
+    try:
+        for _ in range(3):
+            gw.submit([1, 2, 3], 4)              # depth -> 3
+        with pytest.raises(GatewayOverloaded) as ei:
+            gw.submit([1, 2, 3], 4, priority="offline")   # bound 2
+        assert (ei.value.tier, ei.value.priority) == (2, "offline")
+        gw.submit([1, 2, 3], 4, priority="batch")         # bound 4
+        with pytest.raises(GatewayOverloaded) as ei:
+            gw.submit([1, 2, 3], 4, priority="batch")     # depth 4
+        assert (ei.value.tier, ei.value.priority) == (2, "batch")
+        gw.submit([1, 2, 3], 4)                  # interactive: bound 8
+
+        # synthetic burn: a window of TTFT observations far over the
+        # 10ms target -> burn >> 1 -> the tracker reports breached
+        gw.slo.tick(force=True)
+        for _ in range(5):
+            gw._m_ttft.observe(5000.0)
+        gw.slo.tick(force=True)
+        assert gw.slo.breached
+        with pytest.raises(GatewayOverloaded, match="shedding batch") \
+                as ei:
+            gw.submit([1, 2, 3], 4, priority="batch")
+        assert ei.value.tier == 3
+        gw.submit([1, 2, 3], 4)          # interactive rides through
+        with pytest.raises(ValueError, match="unknown priority"):
+            gw.submit([1, 2, 3], 4, priority="p0")
+
+        assert reg.value("gateway_shed_total", priority="offline",
+                         tier="2", model="m") \
+            - shed0[("offline", "2")] == 1
+        assert reg.value("gateway_shed_total", priority="batch",
+                         tier="2", model="m") \
+            - shed0[("batch", "2")] == 1
+        assert reg.value("gateway_shed_total", priority="batch",
+                         tier="3", model="m") \
+            - shed0[("batch", "3")] == 1
+        mix = gw.state()["priority_mix"]
+        assert mix["interactive"] == 5 and mix["batch"] == 1
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# live hot-swap: zero dropped, version-keyed bit-identity
+# ---------------------------------------------------------------------------
+def test_hot_swap_zero_dropped_bit_identical(cfg, params, params_b):
+    """Weights replaced mid-stream: every accepted request completes
+    (nothing dropped), requests accepted before the swap finish on
+    the OLD build bit-identically, requests after ride the new one —
+    each response's version label names the weights its tokens came
+    from."""
+    by_version = {"v0": params, "v1": params_b}
+    fleet = FleetGateway(
+        [ModelSpec("m", _fac(cfg, params), replicas=2,
+                   max_replicas=2)], supervise=False)
+    try:
+        prompt = [2, 4, 6, 8]
+        pre = [fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": i}) for i in range(6)]
+        out = fleet.hot_swap("m", params=params_b)
+        assert out == {"model": "m", "version": "v1",
+                       "from_version": "v0", "swapped": 2,
+                       "still_draining": []}
+        post = [fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": 100 + i}) for i in range(3)]
+        for i, h in enumerate(pre):
+            toks = list(h.result(timeout=120))
+            assert h.version == "v0", (i, h.version)
+            assert toks == _reference(cfg, params, prompt, 12, seed=i,
+                                      temperature=0.9), i
+        for i, h in enumerate(post):
+            toks = list(h.result(timeout=120))
+            assert h.version == "v1", (i, h.version)
+            assert toks == _reference(cfg, by_version[h.version],
+                                      prompt, 12, seed=100 + i,
+                                      temperature=0.9), i
+        assert fleet.pool("m").version == "v1"
+        assert all(r.version == "v1"
+                   for r in fleet.pool("m").replicas())
+        assert telemetry.registry().value("fleet_swap_total",
+                                          model="m") >= 1
+    finally:
+        fleet.close()
+
+
+def test_hot_swap_from_checkpoint_path(cfg, params, params_b,
+                                       tmp_path):
+    """The deployment path: new weights arrive as a PR 11 checkpoint
+    snapshot on disk; ``hot_swap(path=...)`` reloads and serves them
+    (response tokens match a generate with the RELOADED weights)."""
+    from mxtpu import checkpoint
+    ckpt = str(tmp_path / "swap_ckpt")
+    checkpoint.save_state(ckpt, params_b)
+    fleet = FleetGateway([ModelSpec("m", _fac(cfg, params))],
+                         supervise=False)
+    try:
+        out = fleet.hot_swap("m", path=ckpt)
+        assert out["version"] == "v1"
+        h = fleet.submit_dict({"prompt": [3, 1, 4], "max_new_tokens": 5,
+                               "temperature": 0.7, "seed": 9})
+        assert list(h.result(timeout=120)) == _reference(
+            cfg, params_b, [3, 1, 4], 5, seed=9, temperature=0.7)
+        # a factory that can't accept params= fails loudly, pre-drain
+        fleet._models["m"].spec.engine_factory = \
+            lambda: ServeEngine(cfg, params)
+        with pytest.raises(ValueError, match="params= keyword"):
+            fleet.hot_swap("m", params=params)
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# session affinity
+# ---------------------------------------------------------------------------
+def test_session_affinity_routes_to_warm_replica(cfg, params):
+    """A returning session_id lands on the replica that served it
+    (even when another replica is less loaded), counted as a hit; a
+    first-seen session counts as a miss."""
+    reg = telemetry.registry()
+    h0 = reg.value("fleet_session_affinity_total", result="hit")
+    m0 = reg.value("fleet_session_affinity_total", result="miss")
+    fleet = FleetGateway(
+        [ModelSpec("m", _fac(cfg, params), replicas=2,
+                   max_replicas=2)], supervise=False)
+    try:
+        names = []
+        for i in range(3):
+            h = fleet.submit_dict(
+                {"prompt": [1, 2, 3], "max_new_tokens": 3,
+                 "seed": i, "session_id": "sess-A"})
+            h.result(timeout=120)
+            names.append(h.ticket.replica.name)
+        assert len(set(names)) == 1, names
+        assert reg.value("fleet_session_affinity_total",
+                         result="hit") - h0 == 2
+        assert reg.value("fleet_session_affinity_total",
+                         result="miss") - m0 == 1
+        st = fleet.state()
+        assert st["affinity_sessions"] == 1
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the fleet arbiter over REAL pools end to end (scaled-down): a
+# burning pool is granted the idle pool's chip and the backlog drains
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_arbiter_real_pools_grant_under_pressure(cfg, params,
+                                                 params_b):
+    """Integration: real two-model fleet, the hot pool's queue
+    pressure (driven by real queued work) triggers a grant funded by
+    the idle pool — asserted via the pools' live sizes and
+    ``fleet_scale_events_total`` — and the backlog then completes
+    bit-identically on the grown pool."""
+    reg = telemetry.registry()
+    up0 = reg.value("fleet_scale_events_total", model="hot",
+                    direction="up")
+    fleet = FleetGateway(
+        [ModelSpec("hot", _fac(cfg, params), replicas=1,
+                   max_replicas=2),
+         ModelSpec("cold", _fac(cfg, params_b), replicas=2,
+                   min_replicas=1, max_replicas=2)],
+        arbiter=ArbiterPolicy(chip_budget=3, interval_s=0.05,
+                              cooldown_s=0.2, pressure_high=1.5,
+                              occupancy_low=0.5, idle_s=0.1),
+        supervise=False)
+    try:
+        prompt = [7, 3, 7, 3]
+        hs = [fleet.submit_dict(
+            {"model": "hot", "prompt": prompt, "max_new_tokens": 16,
+             "temperature": 0.6, "seed": i}) for i in range(10)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if reg.value("fleet_scale_events_total", model="hot",
+                         direction="up") > up0:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"no grant: {fleet.arbiter.describe()}")
+        assert fleet.pool("hot").size == 2
+        assert fleet.pool("cold").size == 1
+        for i, h in enumerate(hs):
+            assert list(h.result(timeout=120)) == _reference(
+                cfg, params, prompt, 16, seed=i, temperature=0.6), i
+        last = fleet.arbiter.last_decision("cold")
+        assert last and last["direction"] == "down"
+    finally:
+        fleet.close()

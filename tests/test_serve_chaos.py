@@ -32,6 +32,7 @@ handoff frames carry a versioned context header old decoders still
 accept, and ``tools/diagnose.py timeline`` stitches the per-process
 trace streams into valid chrome-trace JSON.
 """
+import gc
 import json
 import os
 import threading
@@ -783,3 +784,102 @@ def test_tier1_deadline_aware_shed_and_healthz(cfg, params):
                 cfg, params, np.arange(4) % cfg.vocab_size, 2, seed=i)
     finally:
         gw.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: replica kill during a fleet hot-swap
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_fleet_replica_kill_mid_swap_bit_identical(cfg, params):
+    """The fleet swap under fire: a chaos-killed old-build replica
+    DURING a live checkpoint hot-swap. Contract: zero accepted
+    requests dropped; every request that was accepted on the old
+    build finishes on the old build (version-aware re-dispatch lands
+    on the still-draining old replica, never the new weights), so
+    every token list is bit-identical to a fault-free generate with
+    the weights its version label names."""
+    from mxtpu.serve.fleet import FleetGateway, ModelSpec
+
+    reg = telemetry.registry()
+    rd0 = reg.value("gateway_redispatch_total", model="m")
+    p1 = llama.init_params(cfg, jax.random.PRNGKey(1))
+    a_prompt = [3, 1, 4, 1, 5, 9]
+    b_prompt = [2, 7, 1, 8]
+    # every fault-free reference BEFORE the fleet exists: reference
+    # compiles must not race the live engine threads' own compiles
+    ref_anchor = _reference(cfg, params, a_prompt, 16, seed=99,
+                            temperature=0.9)
+    ref_anchor2 = _reference(cfg, params, a_prompt, 12, seed=98,
+                             temperature=0.9)
+    ref_burst = [_reference(cfg, params, b_prompt, 8, seed=i,
+                            temperature=0.8) for i in range(6)]
+    ref_post = [_reference(cfg, p1, b_prompt, 6, seed=200 + i,
+                           temperature=0.8) for i in range(4)]
+    fleet = FleetGateway(
+        [ModelSpec("m", lambda params=params: _engine(cfg, params),
+                   replicas=2, max_replicas=2)],
+        supervisor_opts=SUP)
+    try:
+        reps = fleet.pool("m").replicas()
+        gw = fleet.gateway("m")
+        # pre-warm BOTH engines (prefill bucket-4 + decode compiles)
+        # so the kill's step timing is milliseconds, not compile-bound
+        for r in reps:
+            gw.submit(b_prompt, 2, seed=50,
+                      prefer_replica=r.name).result(timeout=180)
+        # anchors: sampled requests PINNED to r1 — its first prefill
+        # hits the cold bucket-8 program, so r1 is busy (a multi-
+        # second compile, then decode) far past the kill detection
+        # window, and stays a live old-build target for the whole
+        # drain: redispatched v0 work always has a same-build home,
+        # never the new weights
+        anchor = gw.submit(a_prompt, 16, temperature=0.9, seed=99,
+                           prefer_replica=reps[1].name)
+        anchor2 = gw.submit(a_prompt, 12, temperature=0.9, seed=98,
+                            prefer_replica=reps[1].name)
+        burst = [fleet.submit_dict(
+            {"prompt": b_prompt, "max_new_tokens": 8,
+             "temperature": 0.8, "seed": i}) for i in range(6)]
+        # kill r0 a few engine steps from NOW (it holds most of the
+        # burst: >= 8 dispatches pending, so the kill always fires —
+        # within milliseconds, during the swap's surge spawn)
+        plan = attach_serve(fleet.pool("m"), ServeChaosPlan(
+            seed=5,
+            kill_replica={0: reps[0].engine.steps_run + 6}))
+        out = fleet.hot_swap("m", params=p1)
+        assert out["version"] == "v1" and out["swapped"] >= 1
+        assert out["still_draining"] == []
+        assert plan.injected["replica_kill"] == 1, plan.injected
+
+        # zero dropped: everything accepted pre-swap completes, on
+        # the OLD build, bit-identical to a fault-free v0 run
+        for h, want in ((anchor, ref_anchor), (anchor2, ref_anchor2)):
+            toks = list(h.result(timeout=180))
+            assert h.reason == "complete"
+            assert h.version == "v0"
+            assert toks == want
+        for i, h in enumerate(burst):
+            toks = list(h.result(timeout=180))
+            assert h.reason == "complete", (i, h.reason)
+            assert h.version == "v0", (i, h.version)
+            assert toks == ref_burst[i], i
+        # the kill really forced a mid-swap re-dispatch
+        assert reg.value("gateway_redispatch_total",
+                         model="m") - rd0 >= 1
+
+        # a supervisor respawn racing the swap can leave one old-build
+        # replica in routing; retire it so the post-swap pool is
+        # uniformly the new build
+        for r in fleet.pool("m").replicas():
+            if r.version != "v1":
+                fleet.pool("m").drain_replica(r)
+        for i in range(4):
+            h = fleet.submit_dict(
+                {"prompt": b_prompt, "max_new_tokens": 6,
+                 "temperature": 0.8, "seed": 200 + i})
+            toks = list(h.result(timeout=180))
+            assert h.version == "v1", (i, h.version)
+            assert toks == ref_post[i], i
+    finally:
+        fleet.close()
+        gc.collect()   # release the engines' compiled executables

@@ -549,3 +549,54 @@ def test_train_step_dispatch_span():
     assert float(loss) > 0
     h = tm.registry().get("span_train_dispatch_ms")
     assert h is not None and h.count - before == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: fleet series ride the existing registry without breaking
+# any grandfathered series name
+# ---------------------------------------------------------------------------
+def test_goodput_ratio_has_fleet_loop_member():
+    """``mxtpu_goodput_ratio{loop=...}`` is the ONE goodput family;
+    the fleet admission ratio joins it as ``loop="fleet"`` alongside
+    the train/serve members — same name, same gauge type, one more
+    label value."""
+    from mxtpu.telemetry.perfscope import goodput_gauge
+    goodput_gauge("train").set(0.5)
+    goodput_gauge("serve").set(0.75)
+    goodput_gauge("fleet").set(0.9)
+    s = tm.parse_prometheus(tm.prometheus())["samples"]
+    vals = {dict(lab)["loop"]: v for (name, lab), v in s.items()
+            if name == "mxtpu_goodput_ratio"}
+    assert vals["fleet"] == 0.9
+    assert {"train", "serve", "fleet"} <= set(vals)
+    assert tm.parse_prometheus(tm.prometheus())["types"][
+        "mxtpu_goodput_ratio"] == "gauge"
+
+
+def test_gateway_requests_model_label_grandfathers_unlabeled():
+    """A fleet deployment adds ``model=`` to the gateway request
+    counters; a single-model gateway keeps emitting the EXACT
+    pre-fleet series (``{code}`` only). Both label shapes coexist in
+    one scrape under one family header, and the strict-grammar parser
+    accepts it — existing dashboards keyed on the unlabeled series
+    never notice the fleet exists."""
+    reg = tm.registry()
+    plain0 = reg.value("gateway_requests_total", code="accepted")
+    mod0 = reg.value("gateway_requests_total", code="accepted",
+                     model="grandfather-m")
+    reg.counter("gateway_requests_total", "by outcome code",
+                code="accepted").inc(3)
+    reg.counter("gateway_requests_total", "by outcome code",
+                code="accepted", model="grandfather-m").inc(2)
+    s = tm.parse_prometheus(tm.prometheus())["samples"]
+    assert s[("mxtpu_gateway_requests_total",
+              (("code", "accepted"),))] == plain0 + 3
+    assert s[("mxtpu_gateway_requests_total",
+              (("code", "accepted"),
+               ("model", "grandfather-m")))] == mod0 + 2
+    # the two shapes are distinct series: incrementing one never
+    # moves the other
+    assert reg.value("gateway_requests_total",
+                     code="accepted") == plain0 + 3
+    assert reg.value("gateway_requests_total", code="accepted",
+                     model="grandfather-m") == mod0 + 2

@@ -19,6 +19,11 @@ roofline attribution table (program, cost-model FLOPs/bytes,
 compute- vs memory-bound class, live MFU, share of wall time) from
 one /metrics scrape — this process, a gateway address, or a saved
 scrape file.
+
+``python tools/diagnose.py fleet <host:port>`` renders a running
+fleet gateway's per-model pool table (replicas, build version,
+priority mix, SLO burn, chips, last arbiter decision) from one
+/state + /metrics scrape.
 """
 import glob as _glob
 import json
@@ -110,6 +115,93 @@ def gateway_state(addr: str = ""):
         for d in scaler.get("decisions", []):
             print(f"  scale {d['direction']} {d['from']}->{d['to']} "
                   f"pressure={d['pressure']} p99={d['p99_ms']}")
+
+
+def fleet_state(addr: str = ""):
+    """``python tools/diagnose.py fleet <host:port>`` — the fleet
+    control plane at a glance, from ONE /state + ONE /metrics scrape
+    of a running :class:`~mxtpu.serve.fleet.FleetGateway`: a per-model
+    pool table (replicas vs bounds, build version, queue, priority
+    mix, SLO burn, chips, last arbiter decision), the arbiter's chip
+    ledger, and which ``process=`` labels the federated scrape joins
+    (``MXTPU_GATEWAY_ADDR=host:port``, or pass the address)."""
+    addr = addr or os.environ.get("MXTPU_GATEWAY_ADDR", "")
+    if not addr:
+        return False
+    host, _, port = addr.partition(":")
+    print(f"----------Fleet state ({addr})----------")
+    try:
+        from mxtpu.serve.gateway import GatewayClient
+        cli = GatewayClient(host, int(port or 9300), timeout=5.0)
+        status, state = cli.get_json("/state")
+        mstatus, text = cli.get_text("/metrics")
+    except Exception as e:
+        print(f"unreachable: {e!r}")
+        return False
+    if status != 200 or mstatus != 200:
+        print(f"HTTP {status}/{mstatus}: {state}")
+        return False
+    models = state.get("models")
+    if not isinstance(models, dict):
+        print("not a fleet gateway (no per-model state); try "
+              "`diagnose.py gateway` semantics via the default report")
+        return False
+    from mxtpu import telemetry
+    try:
+        samples = telemetry.parse_prometheus(text)["samples"]
+    except ValueError as e:
+        print(f"malformed /metrics scrape: {e}")
+        return False
+    # burn per model: the AGGREGATE series (no process label) — the
+    # federated scrape also carries per-process copies, which the
+    # process list below accounts for
+    burn, chips = {}, {}
+    for (name, labels), value in samples.items():
+        d = dict(labels)
+        if "process" in d:
+            continue
+        if name == "mxtpu_gateway_slo_burn_rate" and "model" in d:
+            burn[d["model"]] = max(burn.get(d["model"], 0.0), value)
+        elif name == "mxtpu_fleet_chips_in_use" and "model" in d:
+            chips[d["model"]] = int(value)
+    lines = [("model", "ver", "replicas", "queue", "active",
+              "priority mix", "burn", "chips", "last decision")]
+    for name, st in sorted(models.items()):
+        mix = st.get("priority_mix") or {}
+        mix_s = "/".join(str(mix.get(p, 0)) for p in
+                         ("interactive", "batch", "offline"))
+        d = st.get("arbiter_last")
+        last = "-" if not d else (
+            f"{d['direction']} {d['from']}->{d['to']} "
+            f"({d['reason']})")
+        b = burn.get(name)
+        lines.append((
+            name, str(st.get("version", "-")),
+            f"{st['n_replicas']} [{st.get('min_replicas', '?')},"
+            f"{st.get('max_replicas', '?')}]",
+            f"{st['queued']}/{st['queue_max']}",
+            f"{st['active']}/{st['slots']}", mix_s,
+            "-" if b is None else f"{b:.2f}",
+            str(chips.get(name, "-")), last))
+    widths = [max(len(row[i]) for row in lines)
+              for i in range(len(lines[0]))]
+    for row in lines:
+        print("  ".join(c.ljust(w)
+                        for c, w in zip(row, widths)).rstrip())
+    arb = state.get("arbiter")
+    if arb:
+        print(f"arbiter: budget={arb['budget']} free={arb['free']} "
+              f"cooldown={arb['cooldown_s']}s")
+        for d in arb.get("decisions", []):
+            print(f"  {d['model']}: {d['direction']} "
+                  f"{d['from']}->{d['to']} reason={d['reason']} "
+                  f"pressure={d['pressure']} burn={d['burn']}")
+    print(f"affinity sessions: {state.get('affinity_sessions', 0)}")
+    procs = sorted({dict(lab).get("process")
+                    for (_, lab) in samples
+                    if dict(lab).get("process")})
+    print(f"federated processes: {', '.join(procs) or '(local only)'}")
+    return True
 
 
 def elastic_state(addr: str = ""):
@@ -431,6 +523,13 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "perf":
         source = sys.argv[2] if len(sys.argv) > 2 else ""
         sys.exit(0 if perf(source) else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        addr = sys.argv[2] if len(sys.argv) > 2 else ""
+        if not addr and not os.environ.get("MXTPU_GATEWAY_ADDR"):
+            print("usage: diagnose.py fleet <host:port>  (or set "
+                  "MXTPU_GATEWAY_ADDR)")
+            sys.exit(2)
+        sys.exit(0 if fleet_state(addr) else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "elastic":
         addr = sys.argv[2] if len(sys.argv) > 2 else ""
         if not addr and not os.environ.get("MXTPU_ELASTIC_COORD_ADDR"):
