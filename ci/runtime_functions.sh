@@ -38,6 +38,23 @@ mxlint() {
     # second half of that contract is the tier-1 tests/test_mxlint.py
     # gate). Stdlib-only — runs in well under a second.
     python -m tools.mxlint mxtpu/ example/
+    # the deep pass (lockset/lock-order, determinism, runtime
+    # contracts — docs/lint.md §"The deep pass") over the runtime
+    # tree, emitting SARIF for PR annotation; render the report with
+    # `python tools/diagnose.py lint`
+    python -m tools.mxlint --deep --sarif build/mxlint_deep.sarif \
+        mxtpu/ tools/ bench.py
+}
+
+lockcheck_smoke() {
+    # the runtime half of MXL203 (docs/lint.md §lockcheck): replay a
+    # gateway replica-kill chaos test with every lock instrumented, in
+    # a FRESH process so the factory patch precedes all lock
+    # construction; conftest fails the session on any acquisition
+    # order contradicting itself or the static lock graph
+    MXTPU_ANALYSIS_LOCKCHECK=1 python -m pytest \
+        tests/test_serve_chaos.py::test_replica_kill_poisson_stream_bit_identical \
+        -x -q "$@"
 }
 
 unittest_cpu_mesh() {
@@ -666,6 +683,7 @@ ci_all() {
     fleet_smoke
     chaos_serve
     chaos_train
+    lockcheck_smoke
     telemetry_smoke
     opperf_coverage
     bench_gate
@@ -685,6 +703,7 @@ ci_fast() {
     fleet_smoke
     chaos_serve
     chaos_train
+    lockcheck_smoke
     telemetry_smoke
 }
 

@@ -36,6 +36,30 @@ if base.env_bool("MXTPU_DEBUG_NANS", False,
     import jax as _jax
     _jax.config.update("jax_debug_nans", True)
 
+# Lockset sanitizer (docs/lint.md §MXL203): patch the threading lock
+# factories BEFORE any mxtpu class constructs one, so every serve/
+# fleet/kvstore lock records real acquisition orders for the mxlint
+# lock-graph cross-check. Loaded by file path: the normal package
+# route (mxtpu.contrib.analysis) imports back through mxtpu.contrib
+# and would be circular this early; registering the canonical module
+# name makes later `from mxtpu.contrib.analysis import lockcheck`
+# resolve to this same instance.
+if base.env_bool("MXTPU_ANALYSIS_LOCKCHECK", False,
+                 "Record runtime lock-acquisition orders and fail on "
+                 "contradictions with the static lock graph "
+                 "(diagnostic; see docs/lint.md)."):
+    import importlib.util as _ilu
+    import os as _os
+    import sys as _sys
+    _lc_path = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                             "contrib", "analysis", "lockcheck.py")
+    _lc_spec = _ilu.spec_from_file_location(
+        "mxtpu.contrib.analysis.lockcheck", _lc_path)
+    _lockcheck = _ilu.module_from_spec(_lc_spec)
+    _sys.modules[_lc_spec.name] = _lockcheck
+    _lc_spec.loader.exec_module(_lockcheck)
+    _lockcheck.install()
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray

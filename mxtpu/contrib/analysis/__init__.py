@@ -10,13 +10,24 @@ Two halves:
   ``MXL100``): static shape/dtype inference that reports the first
   inconsistent node with op name and inferred shapes; reused by the
   ONNX exporter and exposed as ``Symbol.validate()``.
+- The deep pass (:mod:`.deep`): whole-repo lockset/lock-order
+  analysis (``MXL201``-``MXL203``), determinism (``MXL301``-``MXL303``)
+  and runtime-contract drift (``MXL401``/``MXL402``). Run with
+  ``python -m tools.mxlint --deep``. Its dynamic counterpart is
+  :mod:`.lockcheck` — ``MXTPU_ANALYSIS_LOCKCHECK=1`` instruments every
+  lock and cross-checks real acquisition orders against the static
+  lock graph.
 
 See docs/lint.md for rule semantics and the suppression syntax.
 """
 from .rules import (RULES, Finding, iter_python_files, lint_file,
                     lint_paths, lint_source)
 from .graph import GraphIssue, format_issues, validate_graph
+from .deep import (DEEP_RULES, LockGraph, deep_lint_file,
+                   deep_lint_paths, deep_lint_source, lock_graph_for)
 
 __all__ = ["RULES", "Finding", "lint_source", "lint_file", "lint_paths",
            "iter_python_files", "GraphIssue", "validate_graph",
-           "format_issues"]
+           "format_issues", "DEEP_RULES", "deep_lint_source",
+           "deep_lint_file", "deep_lint_paths", "lock_graph_for",
+           "LockGraph"]

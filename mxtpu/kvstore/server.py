@@ -488,8 +488,10 @@ class KVStoreServer:
         return ("ok",)
 
     def stop(self):
-        self._running = False
         with self._lock:
+            # under _lock: _handle's stop path flips it there too, and
+            # the sweep below must see a settled flag
+            self._running = False
             if self._snap_path:
                 try:                      # graceful exits keep the
                     self._write_snapshot()  # freshest possible state
@@ -705,7 +707,8 @@ class ServerClient:
                 delay = min(delay * 2, self._backoff_max)
 
     def close(self):
-        self._drop_socket()
+        with self._lock:      # never yank _sock from under an
+            self._drop_socket()  # in-flight _roundtrip
 
 
 def main(argv=None) -> int:

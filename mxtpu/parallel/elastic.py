@@ -163,7 +163,8 @@ class ElasticCoordinator:
                  heartbeat_s: Optional[float] = None,
                  lost_after_s: Optional[float] = None,
                  straggler_lag: Optional[int] = None,
-                 straggler_after_s: Optional[float] = None):
+                 straggler_after_s: Optional[float] = None,
+                 clock=None):
         from .. import rpc
         if n_hosts < 1:
             raise ValueError(f"n_hosts must be >= 1, got {n_hosts}")
@@ -178,6 +179,9 @@ class ElasticCoordinator:
         self._straggler_after_s = straggler_after_s \
             if straggler_after_s is not None else _straggler_after_s()
         self._m = _metrics()
+        # injectable like the gateway's: staleness/straggler tests
+        # single-step time instead of sleeping through real windows
+        self._clock = clock or time.monotonic
         self._cond = threading.Condition()
         # host_id -> {"beat": monotonic, "step": int, "lag_since": t|None}
         self._members: Dict[str, Dict[str, Any]] = {}
@@ -223,12 +227,12 @@ class ElasticCoordinator:
         return ("err", f"unknown elastic op {op!r}")
 
     def _join(self, host_id: str):
-        deadline = time.monotonic() + _join_timeout_s()
+        deadline = self._clock() + _join_timeout_s()
         with self._cond:
             first = host_id not in self._members
             rec = self._members.setdefault(
                 host_id, {"beat": 0.0, "step": -1, "lag_since": None})
-            rec["beat"] = time.monotonic()
+            rec["beat"] = self._clock()
             if first and self._sealed_once and \
                     self._gen == self._target_gen:
                 # grow: a brand-new host on a sealed job forces a
@@ -244,7 +248,7 @@ class ElasticCoordinator:
                     self._pending.add(host_id)
                     self._maybe_seal()
                 if not self._cond.wait(timeout=0.05) and \
-                        time.monotonic() > deadline:
+                        self._clock() > deadline:
                     return ("err", "rendezvous timed out: generation "
                             f"{target} never sealed "
                             f"(pending={sorted(self._pending)}, "
@@ -257,7 +261,7 @@ class ElasticCoordinator:
             if rec is None:
                 # evicted (or never joined): tell it to re-rendezvous
                 return ("rejoin", self._target_gen)
-            rec["beat"] = time.monotonic()
+            rec["beat"] = self._clock()
             rec["step"] = max(rec["step"], step)
             self._m["host_step"](host_id).set(rec["step"])
             return ("ok", self._target_gen, len(self._members))
@@ -269,7 +273,7 @@ class ElasticCoordinator:
             return ("ok",)
 
     def _state(self):
-        now = time.monotonic()
+        now = self._clock()
         with self._cond:
             rows = [(h, int(r["step"]), round(now - r["beat"], 3))
                     for h, r in sorted(self._members.items())]
@@ -315,7 +319,7 @@ class ElasticCoordinator:
     def _sweep_loop(self) -> None:
         period = max(0.02, min(self._heartbeat_s, self._lost_after_s / 4))
         while not self._stop.wait(period):
-            now = time.monotonic()
+            now = self._clock()
             with self._cond:
                 if not self._sealed_once:
                     continue           # nobody committed yet — no evictions
@@ -366,12 +370,14 @@ class ElasticMember:
 
     def __init__(self, host_id: str, address: Tuple[str, int],
                  secret: Optional[bytes] = None,
-                 heartbeat_s: Optional[float] = None):
+                 heartbeat_s: Optional[float] = None,
+                 clock=None):
         self.host_id = host_id
         self.address = tuple(address)
         self._secret = _secret() if secret is None else secret
         self._heartbeat_s = heartbeat_s if heartbeat_s is not None \
             else _heartbeat_s()
+        self._clock = clock or time.monotonic
         self.generation = -1
         self.world = 0
         self.members: List[str] = []
@@ -385,7 +391,7 @@ class ElasticMember:
     def _connect(self):
         import socket
         from .. import rpc
-        deadline = time.monotonic() + _join_timeout_s()
+        deadline = self._clock() + _join_timeout_s()
         self._sock = rpc.connect_with_backoff(
             lambda: socket.create_connection(self.address, timeout=5.0),
             deadline)
@@ -420,7 +426,11 @@ class ElasticMember:
         return self.join()
 
     def report_step(self, step: int) -> None:
-        self.step = int(step)
+        # lock-free by design: the beat thread holds _lock across a
+        # coordinator RPC, and the trainer calls this every step — a
+        # GIL-atomic int store cannot tear, and a beat reading the
+        # previous step is harmless (the next beat carries it)
+        self.step = int(step)  # noqa: MXL201 — must not stall the train loop behind an in-flight beat RPC
 
     def _beat_loop(self) -> None:
         from .. import rpc

@@ -178,7 +178,7 @@ def _fast_forward_chain(key, n):
     """``n`` carry-half splits in ONE compiled dispatch (``n`` is a
     traced operand, so one program covers every prefix length)."""
     return jax.lax.fori_loop(
-        0, n, lambda _, k: jax.random.split(k)[0], key)
+        0, n, lambda _, k: jax.random.split(k)[0], key)  # noqa: MXL301 — this IS the chain primitive resume_key replays
 
 
 def resume_key(seed: int, n_emitted: int) -> np.ndarray:
@@ -189,8 +189,8 @@ def resume_key(seed: int, n_emitted: int) -> np.ndarray:
     ``prompt + emitted`` with this key makes token ``n_emitted + 1``
     sample from the same subkey, on the same logits, as the fault-free
     run (the engine's deterministic re-dispatch contract)."""
-    key = jax.random.PRNGKey(int(seed))
-    n = int(n_emitted)
+    key = jax.random.PRNGKey(int(seed))  # noqa: MXL301 — chain ROOT:
+    n = int(n_emitted)                   # resume_key defines the oracle
     if n > 0:
         key = _fast_forward_chain(key, np.int32(n))
     return np.asarray(key, np.uint32)
@@ -545,7 +545,7 @@ class ServeEngine:
         # DIFFERENT jit-cache entry from the PRNGKey device array the
         # normal path passes, so leaving it raw would recompile every
         # prefill bucket once per crash re-dispatch
-        key = (jax.random.PRNGKey(req.seed) if req.rng is None
+        key = (jax.random.PRNGKey(req.seed) if req.rng is None  # noqa: MXL301 — chain position 0 is PRNGKey(seed) by definition; the rng branch is a mid-chain resume key
                else jax.numpy.asarray(np.asarray(req.rng, np.uint32)))
         with self._span_prefill(bucket=bucket, role=self.role):
             tok, self._kv, self._sv = fn(
@@ -556,7 +556,8 @@ class ServeEngine:
                 np.int32(self.cfg.vocab_size if req.top_k is None
                          else req.top_k),
                 np.float32(1.0 if req.top_p is None else req.top_p))
-        self._slot_len[slot] = prompt.size   # host mirror of lengths
+        with self._lock:      # host mirror of lengths — kv_cache_stats
+            self._slot_len[slot] = prompt.size  # sums it under _lock
         return tok
 
     def _inject_into(self, slot: int, h: KVHandoff):
@@ -579,7 +580,8 @@ class ServeEngine:
                 h.k, h.v, np.int32(h.true_len), np.int32(slot),
                 np.int32(h.token), np.asarray(h.rng, np.uint32),
                 self._kv, self._sv)
-        self._slot_len[slot] = h.true_len    # host mirror of lengths
+        with self._lock:      # host mirror of lengths — kv_cache_stats
+            self._slot_len[slot] = h.true_len  # sums it under _lock
         return np.asarray([h.token], np.int32)
 
     def _seat(self, slot: int, rid: int, req: Request) -> None:
@@ -598,14 +600,15 @@ class ServeEngine:
             sampled, self._kv, self._sv = self._decode(
                 self.params, self._kv, self._sv, self._active,
                 self._temps, self._topks, self._topps)
-        self.steps_run += 1
         self._m["steps"].inc()
-        slots = [(s, rid) for s, rid in enumerate(self._slot_rid)
-                 if self._active[s] and rid is not None]
-        # the decode program appends one cache entry per active slot;
-        # mirror that on the host (no readback — MXL004)
-        for s, _rid in slots:
-            self._slot_len[s] += 1
+        with self._lock:
+            self.steps_run += 1
+            slots = [(s, rid) for s, rid in enumerate(self._slot_rid)
+                     if self._active[s] and rid is not None]
+            # the decode program appends one cache entry per active
+            # slot; mirror that on the host (no readback — MXL004)
+            for s, _rid in slots:
+                self._slot_len[s] += 1
         return _Dispatch(sampled, slots, firsts)
 
     def _emit(self, rid: int, token: int, now: float) -> None:
@@ -676,7 +679,8 @@ class ServeEngine:
             out = None
         if prev is not None:
             self._process(prev)
-        self._step_idx += 1
+        with self._lock:
+            self._step_idx += 1
         return out
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -802,6 +806,7 @@ class ServeEngine:
     def reset_stats(self) -> None:
         """Zero the per-engine latency histogram + step counter (the
         bench warmup boundary)."""
-        self._lat.reset()
-        self._last_tok.clear()
-        self.steps_run = 0
+        with self._lock:      # _emit observes/updates these under _lock
+            self._lat.reset()
+            self._last_tok.clear()
+            self.steps_run = 0

@@ -367,12 +367,12 @@ class FleetGateway:
             # the arbiter's next ledger tick shows the bubble)
             if pool.drain_replica(r):
                 swapped += 1
-        deadline = time.monotonic() + float(drain_timeout_s)
+        deadline = self._clock() + float(drain_timeout_s)
         still = []
         for r in old:
             t = r._thread
             if t is not None:
-                t.join(max(0.0, deadline - time.monotonic()))
+                t.join(max(0.0, deadline - self._clock()))
                 if t.is_alive():
                     still.append(r.name)
         m = self._m_swap.get(model)

@@ -603,7 +603,7 @@ class PrefillWorker:
             V = self.cfg.vocab_size
             # device-commit a resume chain (numpy key != PRNGKey
             # device array in the jit cache — engine.py has the story)
-            key = (jax.random.PRNGKey(req.seed) if req.rng is None
+            key = (jax.random.PRNGKey(req.seed) if req.rng is None  # noqa: MXL301 — chain position 0 is PRNGKey(seed); the rng branch is a mid-chain resume key
                    else jax.numpy.asarray(np.asarray(req.rng,
                                                      np.uint32)))
             with dtrace.use(ctx), self._span(bucket=bucket,

@@ -588,7 +588,6 @@ class ReplicaSupervisor:
         if not self.backend.remove_replica(replica):
             return                          # raced another pass
         replica.failed = True               # never routable again
-        self._progress.pop(replica.name, None)
         self._count(reason)
         telemetry.flight().record(
             "gateway", "replica_down", replica=replica.name,
@@ -596,6 +595,9 @@ class ReplicaSupervisor:
             error=(repr(replica.failure)[:200] if replica.failure
                    else None))
         with self._lock:
+            # the window pop shares _lock with _diagnose's iteration —
+            # an unlocked pop here raced the next check() pass
+            self._progress.pop(replica.name, None)
             self.history.append(
                 {"t": now, "replica": replica.name, "reason": reason,
                  "error": (repr(replica.failure)[:120]

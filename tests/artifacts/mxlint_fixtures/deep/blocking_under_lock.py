@@ -1,0 +1,23 @@
+"""mxlint deep fixture — MXL202 blocking-under-lock.
+
+``poll`` sleeps while holding ``_lock``; ``snapshot`` shows the lock
+also guards fast paths, so the stall hits real contenders (and the
+all-regions-block exemption does not apply).
+"""
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ticks = 0
+
+    def poll(self):
+        with self._lock:
+            self._ticks += 1
+            time.sleep(0.05)  # seeded: MXL202
+
+    def snapshot(self):
+        with self._lock:
+            return self._ticks

@@ -1,0 +1,10 @@
+"""mxlint deep fixture — MXL402 unregistered env knob.
+
+The ``MXTPU_*`` read below does not appear in docs/env_var.md, so the
+knob is invisible to operators.
+"""
+import os
+
+
+def poll_interval_s():
+    return float(os.environ.get("MXTPU_FIXTURE_PHANTOM_KNOB", "1.0"))  # seeded: MXL402

@@ -1,0 +1,27 @@
+"""mxlint deep fixture — MXL203 lock-order cycle.
+
+``fwd`` nests ``_a -> _b``, ``rev`` nests ``_b -> _a``: a thread in
+each deadlocks. Both edges of the 2-cycle must be flagged, at the
+inner acquisition sites.
+"""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.balance_a = 0
+        self.balance_b = 0
+
+    def fwd(self, amount):
+        with self._a:
+            with self._b:  # seeded: MXL203
+                self.balance_a -= amount
+                self.balance_b += amount
+
+    def rev(self, amount):
+        with self._b:
+            with self._a:  # seeded: MXL203
+                self.balance_b -= amount
+                self.balance_a += amount

@@ -1,0 +1,23 @@
+"""mxlint deep fixture — MXL201 lockset.
+
+``_n`` is guarded in ``bump`` but written bare in ``reset``: the
+Eraser write-side check must flag exactly the unlocked write.
+"""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0                     # __init__ is pre-publication: clean
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n
+
+    def reset(self):
+        self._n = 0  # seeded: MXL201

@@ -1,0 +1,14 @@
+"""mxlint deep fixture — MXL401 metric label drift.
+
+Two static call sites create the same counter with different label
+sets; the minority site (vs. the first-seen consensus) is flagged.
+"""
+from mxtpu import telemetry
+
+
+def on_hit():
+    telemetry.counter("cache_lookups", result="hit", tier="l1").inc()
+
+
+def on_miss():
+    telemetry.counter("cache_lookups", result="miss").inc()  # seeded: MXL401

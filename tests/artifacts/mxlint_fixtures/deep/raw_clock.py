@@ -1,0 +1,20 @@
+"""mxlint deep fixture — MXL302 raw clock.
+
+``Window`` declares the injectable-clock idiom, then reads the wall
+clock directly in ``expired`` — a test that single-steps ``clock``
+would still see real time there.
+"""
+import time
+
+
+class Window:
+    def __init__(self, horizon_s, clock=None):
+        self._clock = clock or time.monotonic
+        self._horizon_s = float(horizon_s)
+        self._t0 = self._clock()
+
+    def expired(self):
+        return time.monotonic() - self._t0 > self._horizon_s  # seeded: MXL302
+
+    def remaining(self):
+        return max(0.0, self._horizon_s - (self._clock() - self._t0))

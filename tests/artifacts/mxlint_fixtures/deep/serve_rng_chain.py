@@ -1,0 +1,14 @@
+"""mxlint deep fixture — MXL301 serve-path RNG.
+
+The ``mxtpu.serve`` import marks this module as a serve path; the raw
+``PRNGKey`` bypasses the ``serve.resume_key`` chain, so a replayed
+request would not be bit-identical.
+"""
+import jax
+
+import mxtpu.serve
+
+
+def sample_logits(seed, logits):
+    key = jax.random.PRNGKey(seed)  # seeded: MXL301
+    return jax.random.categorical(key, logits)

@@ -1,0 +1,10 @@
+"""mxlint deep fixture — MXL303 unseeded RNG under tests/.
+
+The module-level draw has no ``np.random.seed`` / ``default_rng(seed)``
+anywhere in the file, so reruns see different data.
+"""
+import numpy as np
+
+
+def jitter(n):
+    return np.random.rand(n)  # seeded: MXL303

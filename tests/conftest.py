@@ -25,3 +25,21 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
 # float32 tests compare against NumPy ground truth — use exact f32 matmuls
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Lockcheck verdict (CI ``lockcheck_smoke``): when the run was
+    driven with MXTPU_ANALYSIS_LOCKCHECK=1, every lock acquisition was
+    recorded — fail the session if any observed order contradicts
+    itself or the static lock graph (docs/lint.md §MXL203)."""
+    if os.environ.get("MXTPU_ANALYSIS_LOCKCHECK") != "1":
+        return
+    from mxtpu.contrib.analysis import lockcheck
+    if not lockcheck.installed():
+        return
+    bad = lockcheck.violations()
+    if bad:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        for v in bad:
+            tr.write_line(f"lockcheck: {v}", red=True)
+        session.exitstatus = 1

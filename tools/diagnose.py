@@ -24,6 +24,11 @@ scrape file.
 fleet gateway's per-model pool table (replicas, build version,
 priority mix, SLO burn, chips, last arbiter decision) from one
 /state + /metrics scrape.
+
+``python tools/diagnose.py lint [report]`` renders an mxlint report —
+the SARIF file CI's mxlint stage writes (default
+``build/mxlint_deep.sarif``) or ``--json`` output — as a per-rule
+table: rule, finding count, first site, description.
 """
 import glob as _glob
 import json
@@ -501,6 +506,69 @@ def perf(source: str = ""):
     return True
 
 
+def lint_report(path: str = ""):
+    """``python tools/diagnose.py lint [report]`` — per-rule summary
+    of an mxlint report. Accepts the SARIF 2.1.0 log the CI mxlint
+    stage writes (``--deep --sarif build/mxlint_deep.sarif``) or a
+    ``python -m tools.mxlint --json`` findings array. Stdlib-only:
+    does not import mxtpu."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = path or os.path.join(repo, "build", "mxlint_deep.sarif")
+    if not os.path.exists(path):
+        print(f"lint: no report at {path} — generate one with\n"
+              f"  python -m tools.mxlint --deep --sarif {path} "
+              f"mxtpu/ tools/ bench.py")
+        return False
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except ValueError as e:
+        print(f"lint: malformed report {path}: {e}")
+        return False
+    descs, findings = {}, []          # rule -> desc; (rule, site, msg)
+    if isinstance(data, dict) and "runs" in data:
+        for run in data["runs"]:
+            for rule in run.get("tool", {}).get("driver", {}) \
+                    .get("rules", []):
+                descs[rule["id"]] = rule.get(
+                    "shortDescription", {}).get("text", "")
+            for res in run.get("results", []):
+                loc = (res.get("locations") or
+                       [{}])[0].get("physicalLocation", {})
+                site = (f"{loc.get('artifactLocation', {}).get('uri', '?')}"
+                        f":{loc.get('region', {}).get('startLine', '?')}")
+                findings.append((res.get("ruleId", "?"), site,
+                                 res.get("message", {}).get("text", "")))
+    elif isinstance(data, list):      # tools.mxlint --json
+        for f_ in data:
+            findings.append((f_.get("rule", "?"),
+                             f"{f_.get('path', '?')}:{f_.get('line', '?')}",
+                             f_.get("message", "")))
+    else:
+        print(f"lint: {path} is neither a SARIF log nor an mxlint "
+              f"--json array")
+        return False
+    print(f"----------mxlint report ({path})----------")
+    if not findings:
+        print(f"clean ({len(descs)} rule(s) ran)")
+        return True
+    per_rule = {}
+    for rule, site, msg in findings:
+        per_rule.setdefault(rule, []).append((site, msg))
+    lines = [("rule", "count", "first site", "description")]
+    for rule in sorted(per_rule):
+        group = per_rule[rule]
+        lines.append((rule, str(len(group)), group[0][0],
+                      descs.get(rule, group[0][1])))
+    widths = [max(len(row[i]) for row in lines) for i in range(3)]
+    for row in lines:
+        print("  ".join(c.ljust(w) for c, w in
+                        zip(row[:3], widths)) + "  " + row[3])
+    print(f"{len(findings)} finding(s) across {len(per_rule)} rule(s)"
+          f" — see docs/lint.md for rule semantics and fixes")
+    return True
+
+
 def _tail_disk_dump(n: int = 20):
     """A crashed process can't answer report() — but its flight dump
     on disk can."""
@@ -537,6 +605,9 @@ def main():
                   "MXTPU_ELASTIC_COORD_ADDR)")
             sys.exit(2)
         sys.exit(0 if elastic_state(addr) else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "lint":
+        path = sys.argv[2] if len(sys.argv) > 2 else ""
+        sys.exit(0 if lint_report(path) else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "timeline":
         args = sys.argv[2:]
         if not args:

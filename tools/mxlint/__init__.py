@@ -15,29 +15,60 @@ import sys
 
 _ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
-_RULES_PATH = os.path.join(_ROOT, "mxtpu", "contrib", "analysis",
-                           "rules.py")
+_ANALYSIS = os.path.join(_ROOT, "mxtpu", "contrib", "analysis")
 
 
-def _load_rules():
-    spec = importlib.util.spec_from_file_location("_mxlint_rules",
-                                                  _RULES_PATH)
+def _load_by_path(name, fname):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ANALYSIS, fname))
     mod = importlib.util.module_from_spec(spec)
     sys.modules[spec.name] = mod  # dataclasses resolve via sys.modules
     spec.loader.exec_module(mod)
     return mod
 
 
-rules = _load_rules()
+rules = _load_by_path("_mxlint_rules", "rules.py")
+deep = _load_by_path("_mxlint_deep", "deep.py")
 RULES = rules.RULES
+DEEP_RULES = deep.DEEP_RULES
 Finding = rules.Finding
 lint_source = rules.lint_source
 lint_file = rules.lint_file
 lint_paths = rules.lint_paths
 iter_python_files = rules.iter_python_files
+deep_lint_paths = deep.deep_lint_paths
 
-__all__ = ["RULES", "Finding", "lint_source", "lint_file", "lint_paths",
-           "iter_python_files", "main"]
+__all__ = ["RULES", "DEEP_RULES", "Finding", "lint_source", "lint_file",
+           "lint_paths", "deep_lint_paths", "iter_python_files", "main"]
+
+
+def to_sarif(findings, all_rules):
+    """Findings as a minimal SARIF 2.1.0 log (one run) — what CI
+    uploads for PR annotation and ``tools/diagnose.py lint`` renders."""
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mxlint",
+                "informationUri": "docs/lint.md",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": desc}}
+                          for rid, desc in sorted(all_rules.items())],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }}],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -47,7 +78,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.mxlint",
         description="mxlint: trace-safety static analysis for mxtpu "
-                    "(rules MXL001-MXL004; see docs/lint.md)")
+                    "(rules MXL001-MXL004), plus the --deep "
+                    "concurrency/determinism/contract pass "
+                    "(MXL2xx/3xx/4xx); see docs/lint.md")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint (default: "
                          "mxtpu/ example/ relative to the repo root)")
@@ -55,13 +88,23 @@ def main(argv=None) -> int:
                     help="print the rule table and exit")
     ap.add_argument("--json", action="store_true",
                     help="emit findings as a JSON array")
+    ap.add_argument("--sarif", metavar="FILE",
+                    help="also write a SARIF 2.1.0 report to FILE "
+                         "('-' for stdout)")
     ap.add_argument("--rules", metavar="ID[,ID...]",
                     help="only run these rule IDs")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the deep pass: lockset/lock-order "
+                         "(MXL2xx), determinism (MXL3xx), runtime "
+                         "contracts (MXL4xx)")
     args = ap.parse_args(argv)
 
+    all_rules = dict(RULES)
+    if args.deep or args.list_rules:
+        all_rules.update(DEEP_RULES)
     if args.list_rules:
-        for rid in sorted(RULES):
-            print(f"{rid}  {RULES[rid]}")
+        for rid in sorted(all_rules):
+            print(f"{rid}  {all_rules[rid]}")
         return 0
 
     paths = args.paths or [os.path.join(_ROOT, "mxtpu"),
@@ -72,13 +115,28 @@ def main(argv=None) -> int:
             return 2
     only = args.rules.split(",") if args.rules else None
     findings = lint_paths(paths, rules=only)
+    if args.deep:
+        findings = sorted(
+            findings + deep_lint_paths(paths, rules=only),
+            key=lambda f: (f.path, f.line, f.col, f.rule))
+    if args.sarif:
+        sarif = _json.dumps(to_sarif(findings, all_rules), indent=2)
+        if args.sarif == "-":
+            print(sarif)
+        else:
+            d = os.path.dirname(args.sarif)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(args.sarif, "w") as fh:
+                fh.write(sarif + "\n")
     if args.json:
         print(_json.dumps([f.__dict__ for f in findings], indent=2))
-    else:
+    elif args.sarif != "-":
         for f in findings:
             print(f)
         n_files = sum(1 for _ in iter_python_files(paths))
         status = "clean" if not findings else \
             f"{len(findings)} finding(s)"
-        print(f"mxlint: {n_files} file(s), {status}")
+        deep_tag = " [deep]" if args.deep else ""
+        print(f"mxlint: {n_files} file(s){deep_tag}, {status}")
     return 1 if findings else 0
