@@ -325,6 +325,136 @@ chaos_train() {
     python tools/flakiness_checker.py tests/test_elastic.py -n 3
 }
 
+flywheel_smoke() {
+    # continuous train->serve deployment (docs/robustness.md
+    # §"Continuous deployment"): the full flywheel suite — the
+    # manifest-committed publish seam, the controller state machine,
+    # train/serve chip lending, and BOTH end-to-end cycles
+    # (publish->canary->promote, publish->canary->breach->rollback)
+    # under concurrent train + serve chaos — in a fresh pytest
+    # process, then tools/flakiness_checker.py x3 to prove the chaos
+    # is seeded, then the service path with no pytest fixtures: a
+    # real elastic trainer publishes into a live two-replica fleet,
+    # one candidate promotes on a clean hold window, the next burns
+    # its canary SLO split and auto-rolls-back to last-good, every
+    # response bit-identical to the build version that served it.
+    python -m pytest tests/test_flywheel.py -x -q "$@"
+    python tools/flakiness_checker.py tests/test_flywheel.py -n 3
+    python - << 'PYEOF'
+import os, tempfile
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+import jax.numpy as jnp
+import optax
+from dataclasses import replace
+from mxtpu import telemetry as tm
+from mxtpu.checkpoint import CheckpointManager
+from mxtpu.models import llama
+from mxtpu.parallel import (ElasticTrainer, JournaledData, P,
+                            ShardingRules, StepProgram, create_mesh,
+                            init_state, make_train_step)
+from mxtpu.serve import ServeEngine
+from mxtpu.serve.fleet import (FleetGateway, FlywheelController,
+                               ModelSpec)
+
+cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32, remat=False,
+              attn_impl="dense")
+pa = llama.init_params(cfg, jax.random.PRNGKey(0))
+pb = llama.init_params(cfg, jax.random.PRNGKey(1))
+
+def fac(p0):
+    return lambda params=p0: ServeEngine(cfg, params, max_slots=2,
+                                         max_len=32, min_bucket=4)
+
+prompt = [2, 4, 6, 8]
+def ref(params, seed):
+    out = llama.generate(cfg, params,
+                         jnp.asarray(prompt, jnp.int32)[None], 4,
+                         rng=jax.random.PRNGKey(seed))
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+refs = {"v0": ref(pa, 3), "v1": ref(pb, 3), "v2": ref(pa, 3)}
+
+# a real trainer publishes manifest-committed candidates on a cadence
+def batch_fn(i):
+    rng = np.random.default_rng(1000 + i)
+    return (jnp.asarray(rng.standard_normal((8, 3)).astype(np.float32)),
+            jnp.asarray(rng.standard_normal((8, 2)).astype(np.float32)))
+
+def program(world):
+    mesh = create_mesh(dp=1, devices=jax.devices()[:1])
+    rules = ShardingRules([(r".*", P())])
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+    tx = optax.adam(1e-2)
+    state = init_state({"w": jnp.ones((3, 2), jnp.float32)}, tx,
+                       mesh, rules)
+    return StepProgram(make_train_step(loss_fn, tx, mesh, rules),
+                       state)
+
+d = tempfile.mkdtemp(prefix="flywheel_ci_")
+mgr = CheckpointManager(d, async_save=False)
+tr = ElasticTrainer(program, JournaledData(batch_fn), mgr,
+                    save_every=2, spike_window=0, publish_every=2)
+stats = tr.run(4)
+assert stats["published"] == 2, stats
+
+fleet = FleetGateway([ModelSpec("m", fac(pa), replicas=2,
+                                slo={"ttft_ms": 60000.0})],
+                     supervise=False)
+cand = [pb, pa]
+fly = FlywheelController(
+    fleet, "m", d,
+    load_candidate=lambda ptr: (mgr.restore(int(ptr["step"])),
+                                cand.pop(0))[1],
+    canary_fraction=0.5, hold_ticks=1, burn_high=1.0,
+    max_rollbacks=2, poll_s=0.05, slo={"ttft_ms": 10.0},
+    anomaly_budget=10_000)
+
+# cycle 1: the latest published candidate canaries into 1 of 2
+# replicas, holds a clean window under live traffic, promotes
+fly.tick()
+assert fly.phase == "canary", fly.describe()
+assert fly.canary["version"] == "v1" and fly.canary["canaries"] == 1
+h = fleet.submit_dict({"model": "m", "prompt": prompt,
+                       "max_new_tokens": 4, "seed": 3})
+toks = list(h.result(timeout=180))
+assert toks == refs[h.version], (h.version, toks)
+fly.tick()
+assert fly.phase == "idle" and fleet.pool("m").version == "v1", \
+    fly.describe()
+
+# cycle 2: the next candidate burns its canary SLO split and the
+# controller auto-rolls-back to last-good, within budget
+mgr.publish(2)                      # re-publish: seq advances
+fly.tick()
+assert fly.phase == "canary" and fly.canary["version"] == "v2"
+gw = fleet.gateway("m")
+for _ in range(5):
+    gw.version_ttft("v2").observe(5000.0)
+fly.tick()
+assert fly.phase == "idle" and fly.rollbacks == 1 and not fly.halted
+assert fleet.pool("m").version == "v1", fleet.state()["models"]["m"]
+assert tm.registry().value("fleet_rollback_total", model="m",
+                           reason="slo_burn") == 1
+for r in fleet.pool("m").replicas():
+    if r.version != "v1":
+        fleet.pool("m").drain_replica(r)
+h = fleet.submit_dict({"model": "m", "prompt": prompt,
+                       "max_new_tokens": 4, "seed": 3})
+assert list(h.result(timeout=180)) == refs["v1"]
+assert h.version == "v1", h.version
+mgr.close()
+fleet.close()
+print(f"flywheel_smoke: OK ({stats['published']} published, "
+      f"promote v0->v1, v2 burned and rolled back to v1, "
+      f"responses bit-identical per build)")
+PYEOF
+}
+
 telemetry_smoke() {
     # the observability layer end to end in a fresh process on the
     # ENABLED-BY-DEFAULT path (docs/observability.md): metrics through
@@ -683,6 +813,7 @@ ci_all() {
     fleet_smoke
     chaos_serve
     chaos_train
+    flywheel_smoke
     lockcheck_smoke
     telemetry_smoke
     opperf_coverage
@@ -703,6 +834,7 @@ ci_fast() {
     fleet_smoke
     chaos_serve
     chaos_train
+    flywheel_smoke
     lockcheck_smoke
     telemetry_smoke
 }

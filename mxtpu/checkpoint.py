@@ -39,7 +39,47 @@ import warnings
 from typing import Any, Optional, Tuple
 
 __all__ = ["CheckpointManager", "PreemptionGuard", "save_state",
-           "load_state", "describe_tree_mismatch"]
+           "load_state", "describe_tree_mismatch", "published_path",
+           "publish_pointer", "read_published"]
+
+#: the versioned publish pointer (docs/robustness.md §"Continuous
+#: deployment"): a manifest-committed JSON file naming the checkpoint
+#: step the trainer declares ready to SERVE. The serve side never
+#: scans the step directories — it subscribes to this one file.
+PUBLISHED_POINTER = "latest-published.mxp"
+
+
+def published_path(directory: str) -> str:
+    return os.path.join(os.path.abspath(directory), PUBLISHED_POINTER)
+
+
+def publish_pointer(directory: str, step: int, *, seq: int,
+                    **meta: Any) -> dict:
+    """Atomically commit the ``latest-published`` pointer for
+    ``directory`` (manifest-committed like the data-position journal,
+    so a kill mid-publish leaves either the previous pointer or a
+    detectably-torn one — never a half-written step number). ``seq``
+    is the monotonically increasing publish sequence the subscriber
+    uses to tell "new candidate" from "same pointer re-read"."""
+    from .base import manifest_commit
+    rec = dict(meta, step=int(step), seq=int(seq), time=_time.time())
+    manifest_commit(published_path(directory),
+                    _json.dumps(rec).encode())
+    return rec
+
+
+def read_published(directory: str) -> Optional[dict]:
+    """Validated read of the ``latest-published`` pointer: the pointer
+    dict (``step``/``seq``/publisher metadata), or None when nothing
+    has ever been published. A TORN pointer raises
+    :class:`mxtpu.base.ManifestError` — subscribers skip it the way
+    ``restore()`` skips a torn step, they do not guess."""
+    from .base import manifest_read
+    try:
+        raw = manifest_read(published_path(directory))
+    except FileNotFoundError:
+        return None
+    return _json.loads(raw)
 
 
 def _metrics():
@@ -60,7 +100,7 @@ def _metrics():
         "total": lambda kind: telemetry.counter(
             "checkpoint_total",
             "Checkpoint operations by kind (save/restore/fallback/"
-            "journal).", kind=kind),
+            "journal/publish).", kind=kind),
     }
 
 
@@ -117,6 +157,7 @@ class CheckpointManager:
             enable_async_checkpointing=async_save)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
         self._m = _metrics()
+        self._pub_seq = 0   # publish sequence floor (see publish())
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save a pytree at ``step`` (no-op off the save interval
@@ -271,6 +312,63 @@ class CheckpointManager:
             f"every retained checkpoint under {self.directory} failed "
             f"to restore with a valid journal (steps {candidates})"
         ) from last_err
+
+    # -- publish/subscribe seam (the flywheel's train->serve handoff,
+    # docs/robustness.md §"Continuous deployment") ---------------------
+    def publish(self, step: Optional[int] = None, **meta: Any) -> dict:
+        """Declare ``step`` (default: latest) ready to serve: wait out
+        any in-flight async write, then atomically commit the
+        ``latest-published`` pointer. The pointer is versioned by a
+        publish ``seq`` so a subscriber polling the file can tell a new
+        candidate from a re-read; extra ``meta`` (generation, loss...)
+        rides along for eval gates. Returns the pointer record."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"nothing to publish under {self.directory}")
+        self.wait_until_finished()
+        prev = self.latest_published()
+        self._pub_seq = max(self._pub_seq,
+                            int(prev["seq"]) if prev else 0) + 1
+        rec = publish_pointer(self.directory, step, seq=self._pub_seq,
+                              **meta)
+        self._m["total"]("publish").inc()
+        try:
+            from . import telemetry
+            if telemetry.enabled():
+                telemetry.flight().record(
+                    "checkpoint", "publish", step=int(step),
+                    seq=self._pub_seq, directory=self.directory)
+        except Exception:
+            pass
+        return rec
+
+    def latest_published(self) -> Optional[dict]:
+        """The subscriber view of :meth:`publish`: the current pointer
+        record, or None when nothing is published OR the pointer is
+        torn (a torn pointer is counted + flight-recorded like a torn
+        checkpoint, then treated as absent — the previous candidate
+        keeps serving)."""
+        from .base import ManifestError
+        try:
+            return read_published(self.directory)
+        except ManifestError as e:
+            self._m["total"]("fallback").inc()
+            try:
+                from . import telemetry
+                if telemetry.enabled():
+                    telemetry.flight().record(
+                        "checkpoint", "fallback", step=-1,
+                        what="published-pointer",
+                        directory=self.directory,
+                        error=f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+            warnings.warn(
+                f"latest-published pointer under {self.directory} is "
+                f"torn ({e}); treating as unpublished", RuntimeWarning)
+            return None
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -149,6 +149,13 @@ class InstrumentedLock:
     def locked(self) -> bool:
         return self._inner.locked()
 
+    def _at_fork_reinit(self) -> None:
+        # stdlib modules (concurrent.futures.thread, threading's fork
+        # handlers) re-init module-level locks in the child process;
+        # the wrapper must forward or a post-install import of those
+        # modules fails at attribute lookup
+        self._inner._at_fork_reinit()
+
     # -- Condition plumbing ----------------------------------------------
     def _release_save(self):
         if hasattr(self._inner, "_release_save"):

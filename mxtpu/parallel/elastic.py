@@ -623,7 +623,8 @@ class ElasticTrainer:
                  save_every: int = 1,
                  spike_factor: Optional[float] = None,
                  spike_window: Optional[int] = None,
-                 max_rollbacks: Optional[int] = None):
+                 max_rollbacks: Optional[int] = None,
+                 publish_every: Optional[int] = None):
         self._factory = factory
         self.data = data
         self.manager = manager
@@ -645,19 +646,50 @@ class ElasticTrainer:
                 "MXTPU_ELASTIC_MAX_ROLLBACKS", 2,
                 "Elastic training: rollback-to-checkpoint budget per "
                 "run; exceeding it raises instead of looping forever.")
+        self.publish_every = publish_every if publish_every is not None \
+            else env_int(
+                "MXTPU_FLYWHEEL_PUBLISH_EVERY", 0,
+                "Elastic training: commit the latest-published serve "
+                "pointer every N steps (docs/robustness.md "
+                "§'Continuous deployment'); 0 disables publishing.")
         self.program = None
         self.generation = member.generation if member else 0
+        # chip lending (the fleet arbiter's training tenant): a leased
+        # world size requested via request_world(), applied at the
+        # next step boundary through the same rebuild+restore path a
+        # membership resize takes
+        self._lease_world: Optional[int] = None
+        self._lease_reason = ""
+        self.world_applied: Optional[int] = None
         # chaos/observability hooks: pre_step(i, batch)->batch may
         # raise to simulate a crash; post_save(i, directory) runs after
         # a committed save (the torn-checkpoint injection point)
         self.pre_step_hooks: List[Callable] = []
         self.post_save_hooks: List[Callable] = []
         self._stats = {"useful": 0, "skipped": 0, "replayed": 0,
-                       "rollbacks": 0, "resizes": 0, "preempted": False}
+                       "rollbacks": 0, "resizes": 0, "published": 0,
+                       "lease_resizes": 0, "preempted": False}
 
     # -- internals ---------------------------------------------------------
     def _world(self) -> int:
+        if self.world_applied is not None:
+            return self.world_applied
         return self.member.world if self.member else 1
+
+    def request_world(self, world: int, reason: str = "lease") -> None:
+        """Ask the driver to rebuild at a new world size at the NEXT
+        step boundary — the chip-lending seam the fleet arbiter's
+        training tenant drives (docs/robustness.md §"Continuous
+        deployment"). The program is rebuilt via the factory and
+        restored from a just-committed checkpoint+journal, the same
+        generation-bump path a membership resize takes, so the
+        trajectory stays bit-identical across the lend/borrow.
+        Thread-safe: callable from the arbiter tick thread."""
+        w = int(world)
+        if w < 1:
+            raise ValueError(f"request_world({world}): need >= 1 chip")
+        self._lease_reason = str(reason)
+        self._lease_world = w
 
     def _counters(self):
         from .. import telemetry
@@ -706,12 +738,27 @@ class ElasticTrainer:
                            generation=int(self.generation)))
             for hook in self.post_save_hooks:
                 hook(step, self.manager.directory)
+            self._maybe_publish(step)
+
+    def _maybe_publish(self, step: int) -> None:
+        """Flywheel publish cadence: after a COMMITTED save on the
+        publish interval, wait out the async write and commit the
+        latest-published pointer (the candidate the serve-side
+        FlywheelController will canary). Runs after post_save hooks so
+        a chaos-torn step still gets published — the subscriber must
+        reject it, that is the point of the manifest."""
+        if self.publish_every <= 0 or step % self.publish_every != 0:
+            return
+        self.manager.publish(step, generation=int(self.generation),
+                             world=int(self._world()))
+        self._stats["published"] += 1
 
     def _resize(self, counters) -> int:
         """Re-rendezvous, rebuild the program on the new world size,
         restore from the last committed checkpoint+journal. Returns
         the step to resume from."""
         self.generation = self.member.rejoin()
+        self.world_applied = None      # membership supersedes a lease
         self._stats["resizes"] += 1
         try:
             from .. import telemetry
@@ -723,6 +770,47 @@ class ElasticTrainer:
         except Exception:
             pass
         self.manager.wait_until_finished()
+        self._build()
+        return self._restore()
+
+    def _lease_resize(self, step: int) -> int:
+        """Apply a pending chip lease (request_world): commit the
+        CURRENT step synchronously first so the rebuilt program
+        resumes exactly here with zero replayed batches — a
+        cooperative lend/borrow, unlike a host loss, gets to save
+        before it moves. Returns the step to resume from."""
+        target = int(self._lease_world)
+        self._lease_world = None
+        if target == self._world():
+            return step
+        self.generation += 1           # the lease IS a generation bump
+        self._stats["resizes"] += 1
+        self._stats["lease_resizes"] += 1
+        try:
+            from .. import telemetry
+            telemetry.counter(
+                "elastic_resizes_total",
+                "Elastic mesh rebuilds by cause (membership resizes "
+                "and arbiter chip leases).", reason="lease").inc()
+            if telemetry.enabled():
+                telemetry.flight().record(
+                    "elastic", "lease_resize", step=int(step),
+                    world=target, reason=self._lease_reason,
+                    generation=int(self.generation))
+        except Exception:
+            pass
+        self.manager.wait_until_finished()
+        try:
+            self.manager.save(step, self.program.state_dict(),
+                              force=True)
+        except Exception as e:
+            if type(e).__name__ != "StepAlreadyExistsError":
+                raise
+        self.manager.save_journal(
+            step, dict(self.data.journal(),
+                       generation=int(self.generation)))
+        self.manager.wait_until_finished()
+        self.world_applied = target
         self._build()
         return self._restore()
 
@@ -767,6 +855,10 @@ class ElasticTrainer:
             if self.member is not None and \
                     self.member.resize_pending.is_set():
                 i = self._resize(counters)
+                window.clear()
+                continue
+            if self._lease_world is not None:
+                i = self._lease_resize(i)
                 window.clear()
                 continue
             if guard is not None and guard.preempted:

@@ -1,9 +1,13 @@
 """Fleet control plane: multi-model, multi-tenant serving over the
 gateway — named-model routing, SLO-driven chip arbitration between
-per-model pools, priority classes, live checkpoint hot-swap, and
-session affinity. See docs/serving.md §"Fleet control plane"."""
-from .arbiter import ArbiterPolicy, FleetArbiter
+per-model pools, priority classes, live checkpoint hot-swap, session
+affinity, and the train→serve deployment flywheel (publish → canary →
+promote/auto-rollback with chip lending). See docs/serving.md §"Fleet
+control plane" and docs/robustness.md §"Continuous deployment"."""
+from .arbiter import ArbiterPolicy, FleetArbiter, TrainingTenant
 from .fleet import FleetGateway, FleetPool, ModelSpec
+from .flywheel import FlywheelController
 
 __all__ = ["ArbiterPolicy", "FleetArbiter", "FleetGateway",
-           "FleetPool", "ModelSpec"]
+           "FleetPool", "FlywheelController", "ModelSpec",
+           "TrainingTenant"]

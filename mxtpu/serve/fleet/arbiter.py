@@ -37,7 +37,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ... import telemetry
 from ..gateway.replica import GatewayClosed
 
-__all__ = ["ArbiterPolicy", "FleetArbiter"]
+__all__ = ["ArbiterPolicy", "FleetArbiter", "TrainingTenant"]
 
 
 @dataclass
@@ -81,8 +81,13 @@ class FleetArbiter:
         self.policy = policy
         self._clock = clock or time.monotonic
         self._signals_override = signals
+        # non-serving tenants (the elastic trainer's TrainingTenant)
+        # registered after construction live here, NOT in the fleet's
+        # model mapping — fleet iteration (health/state/metrics) never
+        # sees them, arbitration always does
+        self._tenants: Dict[str, Any] = {}
         self.budget = int(policy.chip_budget) if policy.chip_budget \
-            else sum(e.pool.size * self._cpr(n)
+            else sum(e.pool.size * self._cpr(n, e)
                      for n, e in entries.items())
         self._idle_since: Dict[str, float] = {}
         self._last_scale: Dict[str, float] = {}
@@ -93,13 +98,42 @@ class FleetArbiter:
             "Chips of the fleet budget not allocated to any pool")
         self.decisions: List[Dict[str, Any]] = []   # bounded: tick()
 
-    def _cpr(self, name: str) -> int:
+    def _get(self, name: str):
         entry = self.entries.get(name)
+        return entry if entry is not None else self._tenants.get(name)
+
+    def _items(self):
+        """Live arbitration view: the fleet's model entries plus
+        registered tenants."""
+        out = list(self.entries.items())
+        out.extend(self._tenants.items())
+        return out
+
+    def register(self, name: str, tenant: Any, *,
+                 chips: Optional[int] = None) -> None:
+        """Register a non-serving tenant (e.g. :class:`TrainingTenant`
+        wrapping the elastic mesh) as a claimant/donor. Its current
+        allocation joins the budget — chips it later yields become
+        free budget the pools can claim, and vice versa. Pass
+        ``chips`` to add a different amount (0 = the budget already
+        counted them)."""
+        if name in self.entries or name in self._tenants:
+            raise ValueError(f"arbiter already has a tenant {name!r}")
+        self._tenants[name] = tenant
+        add = int(chips) if chips is not None \
+            else tenant.pool.size * self._cpr(name, tenant)
+        self.budget += add
+        telemetry.flight().record(
+            "fleet", "tenant_register", tenant=name, chips=add,
+            budget=self.budget)
+
+    def _cpr(self, name: str, entry: Any = None) -> int:
+        entry = entry if entry is not None else self._get(name)
         return int(getattr(entry.pool, "chips_per_replica", 1)
                    if entry is not None else 1)
 
     def _bounds(self, name: str) -> tuple:
-        pool = self.entries[name].pool
+        pool = self._get(name).pool
         return (int(getattr(pool, "min_replicas", 1)),
                 int(getattr(pool, "max_replicas", 1 << 30)))
 
@@ -107,7 +141,12 @@ class FleetArbiter:
         """Default signal read: pool load at the source (the same
         numbers the autoscaler used) + the model's SLO burn rate.
         ``slo.tick()`` is rate-limited to its own window, so arbiter
-        cadence cannot chop the burn computation into noise."""
+        cadence cannot chop the burn computation into noise. An entry
+        that carries its own ``signals()`` (a tenant) speaks for
+        itself."""
+        custom = getattr(entry, "signals", None)
+        if custom is not None:
+            return custom()
         pool = entry.pool
         load = pool.load_total()
         n = pool.size
@@ -138,7 +177,7 @@ class FleetArbiter:
                reason: str,
                sigs: Dict[str, Dict[str, float]]
                ) -> Optional[Dict[str, Any]]:
-        entry = self.entries.get(name)
+        entry = self._get(name)
         if entry is None:
             return None
         n = entry.pool.size
@@ -170,7 +209,7 @@ class FleetArbiter:
         pol = self.policy
         now = self._clock()
         sigs: Dict[str, Dict[str, float]] = {}
-        for name, entry in list(self.entries.items()):
+        for name, entry in self._items():
             try:
                 sigs[name] = (
                     self._signals_override(name, entry)
@@ -226,6 +265,24 @@ class FleetArbiter:
                 if d is not None:
                     decisions.append(d)
                     free += self._cpr(donor)
+            if free < need:
+                # still short: PREEMPTIBLE tenants (the training mesh)
+                # yield under serve load without waiting for sustained
+                # idle — training time is the fleet's reserve capacity
+                for donor in (
+                        d for d, s in sigs.items()
+                        if d != name and d not in donors
+                        and getattr(self._get(d), "preemptible", False)
+                        and s["size"] > self._bounds(d)[0]
+                        and not in_cooldown(d)):
+                    if free >= need:
+                        break
+                    d = self._scale(donor, -1, now,
+                                    reason=f"preempt->{name}",
+                                    sigs=sigs)
+                    if d is not None:
+                        decisions.append(d)
+                        free += self._cpr(donor)
             if free >= need:
                 d = self._scale(name, +1, now, reason="hot",
                                 sigs=sigs)
@@ -242,7 +299,7 @@ class FleetArbiter:
 
         # live chip ledger (post-decision sizes)
         used = 0
-        for name, entry in list(self.entries.items()):
+        for name, entry in self._items():
             chips = entry.pool.size * self._cpr(name)
             used += chips
             g = self._m_chips.get(name)
@@ -267,11 +324,10 @@ class FleetArbiter:
         """Live budget + per-pool chips + recent decisions
         (GET /state)."""
         chips = {}
-        for name in list(self.entries):
+        for name, entry in self._items():
             try:
-                chips[name] = self.entries[name].pool.size \
-                    * self._cpr(name)
-            except KeyError:
+                chips[name] = entry.pool.size * self._cpr(name, entry)
+            except Exception:
                 continue
         return {"budget": self.budget, "chips": chips,
                 "free": max(0, self.budget - sum(chips.values())),
@@ -286,3 +342,85 @@ class FleetArbiter:
                 # arbitration must never die quietly; the flight ring
                 # has the event, the next tick retries
                 telemetry.flight().record("fleet", "arbiter_error")
+
+
+class TrainingTenant:
+    """The TRAINING side as an arbiter tenant: register one of these
+    (``FleetArbiter.register`` / ``FleetGateway.register_tenant``) and
+    the elastic mesh joins fleet chip arbitration as claimant AND
+    donor — serving reclaims chips under load, training borrows idle
+    chips back (docs/robustness.md §"Continuous deployment").
+
+    ``resize(chips, reason)`` is the callback into the training side —
+    typically ``ElasticTrainer.request_world`` — invoked from the
+    arbiter tick thread, so it must only REQUEST the change (the
+    trainer applies it at its next step boundary via the
+    generation-bump rebuild). One tenant "replica" is one chip.
+
+    Semantics, in arbiter terms: below ``want`` chips the tenant
+    reports hot (pressure ``hunger_pressure``) and claims from the
+    free budget; at or above ``want`` it reports idle, so chips over
+    ``want`` drain back. Its burn is always 0, so any pool with real
+    SLO burn outranks it. It is ``preemptible``: when a pool is hot
+    and no idle donor covers the need, the arbiter shrinks the tenant
+    immediately — training never blocks serving on "sustained idle"
+    it will never exhibit."""
+
+    preemptible = True
+    gateway = None                    # no SLO: burn reads as 0
+    chips_per_replica = 1
+
+    def __init__(self, resize: Callable[[int, str], None], *,
+                 chips: int, want: Optional[int] = None,
+                 min_chips: int = 1, max_chips: Optional[int] = None,
+                 name: str = "train", hunger_pressure: float = 2.5):
+        self.name = name
+        self._resize = resize
+        self.size = int(chips)
+        self.want = int(want if want is not None else chips)
+        self.min_replicas = int(min_chips)
+        self.max_replicas = int(max_chips if max_chips is not None
+                                else max(self.size, self.want))
+        self.hunger_pressure = float(hunger_pressure)
+        self.pool = self              # entry.pool protocol: itself
+        self._m_lends: Dict[str, Any] = {}
+
+    def signals(self) -> Dict[str, float]:
+        hungry = self.size < self.want
+        return {
+            "pressure": self.hunger_pressure if hungry else 0.0,
+            # never "sustained idle" at/below want: the idle-donation
+            # path would strip a chip the tenant immediately re-claims
+            # (an arbiter-powered oscillation); only surplus over
+            # `want` reads as idle and drains back
+            "occupancy": 0.0 if self.size > self.want else 1.0,
+            "queued": float(max(0, self.want - self.size)),
+            "size": float(self.size), "burn": 0.0}
+
+    def load_total(self) -> Dict[str, int]:
+        # only reached when a signals override bypasses signals()
+        return {"queued": max(0, self.want - self.size),
+                "active": min(self.size, self.want),
+                "slots": max(1, self.size)}
+
+    def scale_to(self, n: int) -> None:
+        n = max(self.min_replicas, min(int(n), self.max_replicas))
+        if n == self.size:
+            return
+        direction = "borrow" if n > self.size else "lend"
+        m = self._m_lends.get(direction)
+        if m is None:
+            m = self._m_lends[direction] = telemetry.counter(
+                "fleet_chip_lends_total",
+                "Chips moved between the training tenant and the "
+                "serving budget by the arbiter (lend = training "
+                "yields to serving, borrow = training reclaims).",
+                tenant=self.name, direction=direction)
+        m.inc(abs(n - self.size))
+        telemetry.flight().record(
+            "fleet", "tenant_resize", tenant=self.name,
+            chips_from=self.size, chips_to=n, direction=direction)
+        # optimistic: the ledger reads the granted size now; the
+        # trainer applies it at its next step boundary
+        self.size = n
+        self._resize(n, f"arbiter-{direction}")

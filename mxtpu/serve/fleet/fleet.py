@@ -123,7 +123,8 @@ class FleetPool(ReplicaSet):
 
 
 class _ModelEntry:
-    __slots__ = ("spec", "pool", "gateway", "swap_seq")
+    __slots__ = ("spec", "pool", "gateway", "swap_seq", "last_good",
+                 "canary")
 
     def __init__(self, spec: ModelSpec, pool: FleetPool,
                  gateway: Gateway):
@@ -131,6 +132,12 @@ class _ModelEntry:
         self.pool = pool
         self.gateway = gateway
         self.swap_seq = itertools.count(1)
+        # rollback anchor: (engine_factory, version) of the build that
+        # last served cleanly — captured before any swap touches the
+        # pool, restored verbatim by rollback()
+        self.last_good: Optional[tuple] = None
+        # live canary descriptor (None outside a canary window)
+        self.canary: Optional[Dict[str, Any]] = None
 
 
 class FleetGateway:
@@ -181,6 +188,11 @@ class FleetGateway:
             "them last.")
         self._m_aff: Dict[str, Any] = {}
         self._m_swap: Dict[str, Any] = {}
+        self._m_canary: Dict[str, Any] = {}
+        self._m_rollback: Dict[tuple, Any] = {}
+        # attached FlywheelControllers by model (continuous-deployment
+        # state surfaced in /healthz + /state; see flywheel.py)
+        self._flywheels: Dict[str, Any] = {}
         # the fleet federates ONCE (per-model gateways get no peers):
         # same env knob + secret discipline as the single-model door
         if federate is None:
@@ -328,53 +340,20 @@ class FleetGateway:
         ``version`` defaults to ``v<n>`` counting per model."""
         entry = self._entry(model)
         pool = entry.pool
-        if engine_factory is None:
-            if path is not None:
-                from ... import checkpoint
-                params = checkpoint.load_state(path)
-            if params is None:
-                raise ValueError(
-                    "hot_swap needs params=, path= or "
-                    "engine_factory=")
-            base = entry.spec.engine_factory
-            try:
-                inspect.signature(base).bind_partial(params=params)
-            except TypeError:
-                raise ValueError(
-                    f"model {model!r}'s engine_factory does not "
-                    f"accept a params= keyword; hot-swap by "
-                    f"params/path requires a factory like "
-                    f"`lambda params=params0: ServeEngine(cfg, "
-                    f"params, ...)`") from None
-            p = params
-            engine_factory = lambda p=p: base(params=p)  # noqa: E731
+        engine_factory = self._resolve_factory(
+            entry, params=params, path=path,
+            engine_factory=engine_factory)
         version = version or f"v{next(entry.swap_seq)}"
         old = pool.replicas()
         old_version = pool.version
+        entry.last_good = (pool._factory, old_version)
+        entry.canary = None            # a full swap ends any canary
         pool.set_factory(engine_factory, version)
         telemetry.flight().record(
             "fleet", "swap_begin", model=model,
             from_version=old_version, to_version=version,
             replicas=len(old))
-        swapped = 0
-        for r in old:
-            fresh = pool.spawn_replica()
-            if fresh is None:
-                raise GatewayClosed(
-                    f"fleet pool {model!r} closed mid-swap")
-            # surge first, retire second: the pool holds >= its
-            # allocation throughout (transiently +1 replica's chips —
-            # the arbiter's next ledger tick shows the bubble)
-            if pool.drain_replica(r):
-                swapped += 1
-        deadline = self._clock() + float(drain_timeout_s)
-        still = []
-        for r in old:
-            t = r._thread
-            if t is not None:
-                t.join(max(0.0, deadline - self._clock()))
-                if t.is_alive():
-                    still.append(r.name)
+        swapped, still = self._swap_out(entry, old, drain_timeout_s)
         m = self._m_swap.get(model)
         if m is None:
             m = self._m_swap[model] = telemetry.counter(
@@ -388,6 +367,205 @@ class FleetGateway:
         return {"model": model, "version": version,
                 "from_version": old_version, "swapped": swapped,
                 "still_draining": still}
+
+    def _resolve_factory(self, entry: _ModelEntry, *,
+                         params: Any = None,
+                         path: Optional[str] = None,
+                         engine_factory=None):
+        """Turn (params | path | engine_factory) into a zero-arg
+        engine factory — the validation hot_swap always did, shared
+        with the canary path."""
+        if engine_factory is not None:
+            return engine_factory
+        if path is not None:
+            from ... import checkpoint
+            params = checkpoint.load_state(path)
+        if params is None:
+            raise ValueError(
+                "hot_swap needs params=, path= or engine_factory=")
+        base = entry.spec.engine_factory
+        try:
+            inspect.signature(base).bind_partial(params=params)
+        except TypeError:
+            raise ValueError(
+                f"model {entry.spec.name!r}'s engine_factory does "
+                f"not accept a params= keyword; hot-swap by "
+                f"params/path requires a factory like "
+                f"`lambda params=params0: ServeEngine(cfg, "
+                f"params, ...)`") from None
+        p = params
+        return lambda p=p: base(params=p)
+
+    def _swap_out(self, entry: _ModelEntry, targets,
+                  drain_timeout_s: float):
+        """Surge-then-drain ``targets`` out of the pool (one fresh
+        replica spawned from the CURRENT factory per target, then the
+        target drains — it finishes everything it accepted on the
+        build that seated it). Returns ``(swapped, still_draining)``.
+        Capacity never dips below the allocation; the transient +1
+        replica shows in the arbiter's next ledger tick."""
+        pool = entry.pool
+        swapped = 0
+        for r in targets:
+            fresh = pool.spawn_replica()
+            if fresh is None:
+                raise GatewayClosed(
+                    f"fleet pool {entry.spec.name!r} closed mid-swap")
+            if pool.drain_replica(r):
+                swapped += 1
+        deadline = self._clock() + float(drain_timeout_s)
+        still = []
+        for r in targets:
+            t = r._thread
+            if t is not None:
+                t.join(max(0.0, deadline - self._clock()))
+                if t.is_alive():
+                    still.append(r.name)
+        return swapped, still
+
+    # -- canary / promote / rollback (the flywheel's verbs) ------------------
+    def canary_swap(self, model: str, *, params: Any = None,
+                    path: Optional[str] = None,
+                    engine_factory=None,
+                    version: Optional[str] = None,
+                    fraction: float = 0.25,
+                    drain_timeout_s: float = 120.0) -> Dict[str, Any]:
+        """Swap a candidate build into a bounded FRACTION of the pool
+        (at least one replica) instead of all of it: the pool's
+        factory/version move to the candidate, but only
+        ``max(1, round(fraction * size))`` replicas are surged+drained
+        — the rest keep serving the incumbent build, and ``route
+        (version=)`` keeps in-flight requests on the build that seated
+        them. The incumbent (factory, version) is recorded as the
+        rollback anchor. NOTE: a supervisor respawn during the canary
+        window comes up on the CANDIDATE build (the pool factory), so
+        the canary fraction can only grow until promote/rollback
+        settles it."""
+        entry = self._entry(model)
+        pool = entry.pool
+        engine_factory = self._resolve_factory(
+            entry, params=params, path=path,
+            engine_factory=engine_factory)
+        version = version or f"v{next(entry.swap_seq)}"
+        old = pool.replicas()
+        old_version = pool.version
+        n = min(len(old), max(1, int(round(float(fraction)
+                                           * len(old)))))
+        entry.last_good = (pool._factory, old_version)
+        pool.set_factory(engine_factory, version)
+        entry.canary = {"version": version,
+                        "from_version": old_version,
+                        "replicas": n, "of": len(old)}
+        telemetry.flight().record(
+            "fleet", "canary_begin", model=model,
+            from_version=old_version, to_version=version,
+            canaries=n, pool=len(old))
+        swapped, still = self._swap_out(entry, old[:n],
+                                        drain_timeout_s)
+        m = self._m_canary.get(model)
+        if m is None:
+            m = self._m_canary[model] = telemetry.counter(
+                "fleet_canary_total",
+                "Candidate builds canaried into a bounded fraction "
+                "of a pool, by model", model=model)
+        m.inc()
+        return {"model": model, "version": version,
+                "from_version": old_version, "canaries": n,
+                "of": len(old), "swapped": swapped,
+                "still_draining": still}
+
+    def promote(self, model: str, *,
+                drain_timeout_s: float = 120.0) -> Dict[str, Any]:
+        """Finish a clean canary: surge+drain the REMAINING incumbent
+        replicas onto the pool's current (candidate) build. The
+        promoted build becomes the next rollback anchor."""
+        entry = self._entry(model)
+        pool = entry.pool
+        version = pool.version
+        targets = [r for r in pool.replicas()
+                   if getattr(r, "version", None) != version]
+        telemetry.flight().record(
+            "fleet", "promote", model=model, to_version=version,
+            remaining=len(targets))
+        swapped, still = self._swap_out(entry, targets,
+                                        drain_timeout_s)
+        entry.canary = None
+        entry.last_good = (pool._factory, version)
+        m = self._m_swap.get(model)
+        if m is None:
+            m = self._m_swap[model] = telemetry.counter(
+                "fleet_swap_total",
+                "Completed live checkpoint hot-swaps, by model",
+                model=model)
+        m.inc()
+        return {"model": model, "version": version,
+                "swapped": swapped, "still_draining": still}
+
+    def rollback(self, model: str, *, reason: str = "breach",
+                 drain_timeout_s: float = 120.0) -> Dict[str, Any]:
+        """The serve-side twin of the elastic trainer's loss-spike
+        rollback: re-seat the pool on the LAST-GOOD build — every
+        replica not already on it is surged+drained away, in-flight
+        requests finish bit-identically on whichever build seated
+        them. Counted in ``fleet_rollback_total{model,reason}`` and
+        flight-recorded with the reason (the operator's first grep
+        after a bad deploy)."""
+        entry = self._entry(model)
+        pool = entry.pool
+        if entry.last_good is None:
+            raise ValueError(
+                f"model {model!r} has no last-good build recorded "
+                f"(nothing was ever swapped); rollback is undefined")
+        factory, version = entry.last_good
+        bad_version = pool.version
+        pool.set_factory(factory, version)
+        targets = [r for r in pool.replicas()
+                   if getattr(r, "version", None) != version]
+        telemetry.flight().record(
+            "fleet", "rollback_begin", model=model, reason=reason,
+            from_version=bad_version, to_version=version,
+            replicas=len(targets))
+        swapped, still = self._swap_out(entry, targets,
+                                        drain_timeout_s)
+        entry.canary = None
+        key = (model, reason)
+        m = self._m_rollback.get(key)
+        if m is None:
+            m = self._m_rollback[key] = telemetry.counter(
+                "fleet_rollback_total",
+                "Serve-side rollbacks to the last-good build, by "
+                "model and reason (slo_burn/anomaly/manual...)",
+                model=model, reason=reason)
+        m.inc()
+        telemetry.flight().record(
+            "fleet", "rollback_done", model=model, reason=reason,
+            to_version=version, swapped=swapped,
+            still_draining=len(still))
+        return {"model": model, "version": version,
+                "from_version": bad_version, "reason": reason,
+                "swapped": swapped, "still_draining": still}
+
+    # -- flywheel / training tenant ------------------------------------------
+    def register_tenant(self, tenant, *,
+                        chips: Optional[int] = None) -> None:
+        """Register a non-serving arbiter tenant (the elastic
+        trainer's :class:`~.arbiter.TrainingTenant`): its chips join
+        the fleet budget and the arbiter lends/borrows between it and
+        the pools. Requires the fleet to have been built with an
+        arbiter policy."""
+        if self.arbiter is None:
+            raise ValueError(
+                "this fleet has no arbiter (pass arbiter= to "
+                "FleetGateway) — nothing would lend chips")
+        self.arbiter.register(tenant.name, tenant, chips=chips)
+
+    def attach_flywheel(self, model: str, controller) -> None:
+        """Hang a :class:`~.flywheel.FlywheelController` off the fleet
+        so ``/healthz``/``/state`` (and ``diagnose flywheel``) surface
+        its phase, canary and decision history. Called by the
+        controller's constructor."""
+        self._entry(model)             # validate the name
+        self._flywheels[model] = controller
 
     # -- observability -------------------------------------------------------
     def _update_goodput(self) -> None:
@@ -429,14 +607,56 @@ class FleetGateway:
                 secret=self._fed_secret)
         return telemetry.prometheus()
 
+    def _health_causes(self, name: str,
+                       h: Dict[str, Any]) -> List[str]:
+        """Name WHY a model reads degraded (the aggregation a single
+        /healthz probe needs to see a sick tenant): each cause is a
+        stable token an alert can match on."""
+        causes = []
+        if h.get("tier", 0) > 0:
+            causes.append(f"shed_tier_{h['tier']}")
+        if h.get("healthy_replicas") == 0:
+            causes.append("no_healthy_replicas")
+        br = h.get("breaker")
+        if br is not None and br.get("state") != "closed":
+            causes.append("breaker_open")
+        sup = h.get("supervisor")
+        if sup:
+            if sup.get("pending_spawns"):
+                causes.append("replica_respawn_pending")
+            if sup.get("restarts", 0) >= sup.get("max_restarts",
+                                                 1 << 30):
+                causes.append("supervisor_exhausted")
+        slo = h.get("slo")
+        if slo and slo.get("breached"):
+            causes.append("slo_burn")
+        fly = self._flywheels.get(name)
+        if fly is not None:
+            if getattr(fly, "rolling_back", False):
+                causes.append("rollback_active")
+            if getattr(fly, "halted", False):
+                causes.append("flywheel_halted")
+        return causes
+
     def health(self) -> Dict[str, Any]:
-        """GET /healthz: per-model health blocks plus the fleet
-        verdict — degraded if ANY model is."""
-        per = {name: entry.gateway.health()
-               for name, entry in self._models.items()}
-        degraded = any(h["status"] != "ok" for h in per.values())
+        """GET /healthz: per-model health blocks — each annotated with
+        its degraded CAUSES (breaker open, supervisor exhausted, SLO
+        burn, active rollback...) — plus the fleet verdict and the
+        list of degraded models, so one probe sees a sick tenant
+        without walking N per-model doors."""
+        per = {}
+        degraded = []
+        for name, entry in self._models.items():
+            h = entry.gateway.health()
+            causes = self._health_causes(name, h)
+            h["causes"] = causes
+            if causes or h["status"] != "ok":
+                h["status"] = "degraded"
+                degraded.append(name)
+            per[name] = h
         return {"ok": True,
                 "status": "degraded" if degraded else "ok",
+                "degraded": degraded,
                 "models": per}
 
     def state(self) -> Dict[str, Any]:
@@ -453,12 +673,17 @@ class FleetGateway:
             st["max_replicas"] = entry.pool.max_replicas
             st["arbiter_last"] = (self.arbiter.last_decision(name)
                                   if self.arbiter else None)
+            st["canary"] = (dict(entry.canary)
+                            if entry.canary else None)
             models[name] = st
         with self._aff_lock:
             sessions = len(self._affinity)
         return {"models": models,
                 "arbiter": (self.arbiter.describe()
                             if self.arbiter else None),
+                "flywheel": {name: fly.describe()
+                             for name, fly
+                             in self._flywheels.items()},
                 "affinity_sessions": sessions}
 
     # -- lifecycle -----------------------------------------------------------
@@ -479,6 +704,11 @@ class FleetGateway:
         if self._closed:
             return
         self._closed = True
+        for fly in list(self._flywheels.values()):
+            try:
+                fly.close()
+            except Exception:
+                pass
         if self._arbiter_stop is not None:
             self._arbiter_stop.set()
         if self._http is not None:

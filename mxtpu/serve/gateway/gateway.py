@@ -178,6 +178,11 @@ class RequestHandle:
             self._first_at = time.perf_counter()
             ttft_ms = 1e3 * (self._first_at - self._submitted_at)
             self._gw._m_ttft.observe(ttft_ms)
+            # per-version split (the flywheel's canary burn signal):
+            # attribute TTFT to the model build that SEATED us
+            ver = self.version
+            if ver is not None:
+                self._gw.version_ttft(ver).observe(ttft_ms)
             entry = self._entry
             if entry is not None and entry.ctx is not None:
                 with dtrace.use(entry.ctx):
@@ -322,6 +327,7 @@ class Gateway:
             "In-flight requests moved off a failed replica and "
             "resumed on a healthy one", **self._mlabels)
         self._m_shed: Dict[tuple, Any] = {}
+        self._m_ttft_ver: Dict[str, Any] = {}
         # accepted-by-priority tally (plain ints under _lock): the
         # /state "priority mix" a fleet diagnose renders per model
         self.priority_tally: Dict[str, int] = {p: 0
@@ -438,6 +444,21 @@ class Gateway:
                 "Requests at the gateway front door, by outcome code",
                 code=code, **self._mlabels)
         m.inc()
+
+    def version_ttft(self, version: str):
+        """The per-model-build TTFT histogram
+        (``gateway_ttft_ms{model,version}``), created on first use.
+        During a canary this is what splits SLO burn by build: the
+        flywheel hangs one :class:`~mxtpu.telemetry.distributed
+        .SLOTracker` off each version's histogram and compares burn
+        rates (docs/robustness.md §"Continuous deployment")."""
+        m = self._m_ttft_ver.get(version)
+        if m is None:
+            m = self._m_ttft_ver[version] = telemetry.histogram(
+                "gateway_ttft_ms",
+                "Time to first token, submission to first on_token",
+                version=version, **self._mlabels)
+        return m
 
     def _count_shed(self, priority: str, tier: int) -> None:
         key = (priority, tier)

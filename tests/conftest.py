@@ -26,6 +26,30 @@ def pytest_configure(config):
 # float32 tests compare against NumPy ground truth — use exact f32 matmuls
 jax.config.update("jax_default_matmul_precision", "highest")
 
+import pytest  # noqa: E402
+
+
+# Session-scoped llama serve scaffolding (the tier-1 budget seam —
+# llama_refs.py): ONE tiny config + weight tree per session, shared
+# by test_serve*/test_gateway/test_fleet so generate references
+# memoize across files instead of recomputing per module.
+@pytest.fixture(scope="session")
+def serve_cfg():
+    import llama_refs
+    return llama_refs.serve_config()
+
+
+@pytest.fixture(scope="session")
+def serve_params(serve_cfg):
+    import llama_refs
+    return llama_refs.serve_weights(0)
+
+
+@pytest.fixture(scope="session")
+def serve_params_b(serve_cfg):
+    import llama_refs
+    return llama_refs.serve_weights(1)
+
 
 def pytest_sessionfinish(session, exitstatus):
     """Lockcheck verdict (CI ``lockcheck_smoke``): when the run was

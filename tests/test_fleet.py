@@ -34,10 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from dataclasses import replace
 
 from mxtpu import telemetry
-from mxtpu.models import llama
 from mxtpu.serve import ServeEngine
 from mxtpu.serve.gateway import (Gateway, GatewayClient, GatewayClosed,
                                  GatewayOverloaded, ReplicaSet)
@@ -50,38 +48,32 @@ SUP = dict(heartbeat_s=0.05, stall_s=30.0, backoff_base_s=0.01,
            backoff_max_s=0.05)
 
 
-@pytest.fixture(scope="module")
-def cfg():
-    return replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
-                   remat=False, attn_impl="dense")
+import llama_refs
 
 
 @pytest.fixture(scope="module")
-def params(cfg):
-    return llama.init_params(cfg, jax.random.PRNGKey(0))
+def cfg(serve_cfg):
+    return serve_cfg
 
 
 @pytest.fixture(scope="module")
-def params_b(cfg):
-    return llama.init_params(cfg, jax.random.PRNGKey(1))
+def params(serve_params):
+    return serve_params
+
+
+@pytest.fixture(scope="module")
+def params_b(serve_params_b):
+    return serve_params_b
 
 
 def _reference(cfg, params, prompt, mnew, seed=0, temperature=0.0):
-    out = llama.generate(
-        cfg, params, jnp.asarray(prompt, jnp.int32)[None], mnew,
-        temperature=temperature, rng=jax.random.PRNGKey(seed))
-    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+    return llama_refs.reference(cfg, params, prompt, mnew, seed=seed,
+                                temperature=temperature)
 
 
-def _fac(cfg, params, **kw):
-    kw.setdefault("max_slots", 2)
-    # max_len 32, not 64: every ServeEngine compiles its own XLA CPU
-    # programs, and the tier-1 suite runs close enough to the CPU
-    # JIT's process-wide code capacity that oversized programs here
-    # can segfault LATER compiles in the run
-    kw.setdefault("max_len", 32)
-    kw.setdefault("min_bucket", 4)
-    return lambda params=params: ServeEngine(cfg, params, **kw)
+# the standard tier-1 engine shape (max_len 32 etc. — see
+# llama_refs.engine_factory for the CPU JIT code-capacity note)
+_fac = llama_refs.engine_factory
 
 
 @pytest.fixture(autouse=True)
@@ -347,6 +339,9 @@ def test_priority_shed_ordering(cfg, params):
 # ---------------------------------------------------------------------------
 # live hot-swap: zero dropped, version-keyed bit-identity
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # ~16s; the swap seam also holds tier-1 coverage
+# through the flywheel state-machine test, and ci_all's full tier +
+# the chaos mid-swap kill test rerun this one
 def test_hot_swap_zero_dropped_bit_identical(cfg, params, params_b):
     """Weights replaced mid-stream: every accepted request completes
     (nothing dropped), requests accepted before the swap finish on

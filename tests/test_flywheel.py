@@ -1,0 +1,888 @@
+"""Flywheel: continuous train→serve deployment (ISSUE 17 /
+docs/robustness.md §"Continuous deployment").
+
+Tier-1 contract (fast, deterministic — fake clocks, fake fleets, no
+engines):
+
+- **publish seam**: the ``latest-published`` pointer is manifest-
+  committed and validated like the PR 11 journal — roundtrip, torn
+  pointer raises (module seam) or reads as unpublished (manager seam,
+  counted + warned), publish ``seq`` is monotonic even across a
+  manager restart;
+- **publish cadence**: the elastic trainer emits a pointer every
+  ``publish_every`` committed saves, carrying generation + world;
+- **controller state machine**: gate veto, torn candidate and torn
+  pointer rejected WITHOUT touching the pool; canary → clean hold →
+  promote; burn breach and anomaly spike → rollback; a spent rollback
+  budget HALTS deployment while last-good keeps serving;
+- **chip lending**: the ``TrainingTenant`` joins arbitration as
+  claimant AND donor on a fake clock — serving preempts training
+  under load, training borrows sustained-idle chips back, both moves
+  ledgered in ``fleet_chips_in_use`` / ``fleet_chip_lends_total``;
+- **surfaces**: fleet ``/healthz`` aggregates per-model degraded
+  causes; ``diagnose.py fleet|flywheel`` render them from one scrape.
+
+The slow tests run the REAL loop end to end — a live elastic trainer
+publishing into a live fleet with TrainChaosPlan + ServeChaosPlan
+attached concurrently — and are the body of the
+ci/runtime_functions.sh ``flywheel_smoke`` stage (reran under
+tools/flakiness_checker.py)."""
+import gc
+import os
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mxtpu import checkpoint, telemetry
+from mxtpu.base import ManifestError
+from mxtpu.checkpoint import CheckpointManager
+from mxtpu.contrib import chaos
+from mxtpu.parallel import (ElasticCoordinator, ElasticMember,
+                            ElasticTrainer, JournaledData, P,
+                            ShardingRules, StepProgram, create_mesh,
+                            init_state, make_train_step)
+from mxtpu.serve.fleet import (ArbiterPolicy, FleetArbiter,
+                               FleetGateway, FlywheelController,
+                               ModelSpec, TrainingTenant)
+
+import llama_refs
+
+SUP = dict(heartbeat_s=0.05, stall_s=30.0, backoff_base_s=0.01,
+           backoff_max_s=0.05)
+# For the e2e chaos tests the ONLY replica deaths must be the ones the
+# chaos plan injects: on an oversubscribed CI box an XLA compile storm
+# (canary surge + respawn compiling concurrently) can starve an
+# already-compiled engine's decode loop past 30s, and a stall-kill of
+# the last incumbent-build replica leaves a redispatched request no
+# same-build home — route() then falls back across builds by design
+# (mxtpu/serve/gateway/replica.py) and the stream shows the seam.
+SUPK = dict(SUP, stall_s=300.0)
+HB = 0.03
+LOST = 0.4
+
+
+@pytest.fixture(scope="module")
+def cfg(serve_cfg):
+    return serve_cfg
+
+
+@pytest.fixture(scope="module")
+def params(serve_params):
+    return serve_params
+
+
+@pytest.fixture(scope="module")
+def params_b(serve_params_b):
+    return serve_params_b
+
+
+def _reference(cfg, params, prompt, mnew, seed=0, temperature=0.0):
+    return llama_refs.reference(cfg, params, prompt, mnew, seed=seed,
+                                temperature=temperature)
+
+
+_fac = llama_refs.engine_factory
+
+
+@pytest.fixture(autouse=True)
+def _release_engines():
+    yield
+    gc.collect()
+
+
+# -- tiny elastic-training program (the test_elastic idiom) -----------------
+def _batch_fn(i):
+    rng = onp.random.default_rng(1000 + i)
+    return (jnp.asarray(rng.standard_normal((8, 3)).astype(onp.float32)),
+            jnp.asarray(rng.standard_normal((8, 2)).astype(onp.float32)))
+
+
+def _make_program(world):
+    mesh = create_mesh(dp=world, devices=jax.devices()[:world])
+    rules = ShardingRules([(r".*", P())])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    tx = optax.adam(1e-2)
+    step = make_train_step(loss_fn, tx, mesh, rules)
+    state = init_state({"w": jnp.ones((3, 2), jnp.float32)}, tx, mesh,
+                       rules)
+    return StepProgram(step, state)
+
+
+# ---------------------------------------------------------------------------
+# publish seam: pointer roundtrip, torn handling, seq monotonicity
+# ---------------------------------------------------------------------------
+def test_publish_pointer_roundtrip_and_torn(tmp_path):
+    """Module seam: absent reads as None; a committed pointer
+    roundtrips step/seq/meta; a TORN pointer raises ManifestError —
+    subscribers skip it like restore() skips a torn step, they never
+    guess at a half-written step number."""
+    d = str(tmp_path)
+    assert checkpoint.read_published(d) is None
+    rec = checkpoint.publish_pointer(d, 4, seq=1, generation=2)
+    assert (rec["step"], rec["seq"], rec["generation"]) == (4, 1, 2)
+    got = checkpoint.read_published(d)
+    assert (got["step"], got["seq"], got["generation"]) == (4, 1, 2)
+    with open(checkpoint.published_path(d), "wb") as f:
+        f.write(b"torn by chaos")
+    with pytest.raises(ManifestError):
+        checkpoint.read_published(d)
+
+
+def test_manager_publish_seq_and_torn_fallback(tmp_path):
+    """Manager seam: publish defaults to the latest committed step and
+    refuses an empty directory; the torn pointer reads as UNPUBLISHED
+    (counted + RuntimeWarning, incumbent keeps serving); the publish
+    seq heals monotonically past a prior manager's pointer."""
+    reg = telemetry.registry()
+    f0 = reg.value("checkpoint_total", kind="fallback")
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    with pytest.raises(FileNotFoundError):
+        mgr.publish()
+    mgr.save(2, {"w": onp.zeros(2, onp.float32)})
+    rec = mgr.publish(loss=0.5)
+    assert (rec["step"], rec["seq"], rec["loss"]) == (2, 1, 0.5)
+    mgr.save(4, {"w": onp.ones(2, onp.float32)})
+    assert mgr.publish()["seq"] == 2
+    # torn pointer: treated as unpublished, loudly
+    with open(checkpoint.published_path(str(tmp_path)), "wb") as f:
+        f.write(b"garbage")
+    with pytest.warns(RuntimeWarning, match="treating as unpublished"):
+        assert mgr.latest_published() is None
+    assert reg.value("checkpoint_total", kind="fallback") - f0 == 1
+    mgr.close()
+
+    # a FRESH manager (publisher restart) heals the pointer and keeps
+    # seq monotonic: it floors at the last readable seq, so the torn
+    # record never rolls the sequence back
+    checkpoint.publish_pointer(str(tmp_path), 2, seq=7)
+    mgr2 = CheckpointManager(str(tmp_path), async_save=False)
+    assert mgr2.publish(4)["seq"] == 8
+    mgr2.close()
+
+
+def test_trainer_publish_cadence(tmp_path):
+    """The elastic trainer publishes every ``publish_every`` committed
+    saves; the pointer carries generation + world for eval gates."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=2, spike_window=0, publish_every=2)
+    s = tr.run(6)
+    mgr.close()
+    assert s["published"] == 3
+    ptr = checkpoint.read_published(str(tmp_path))
+    assert ptr["step"] == 6 and ptr["seq"] == 3
+    assert ptr["generation"] == 0 and ptr["world"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the controller state machine on a fake fleet + fake clock
+# ---------------------------------------------------------------------------
+class _FakeGw:
+    """version_ttft over REAL telemetry histograms so the burn split
+    is the production SLOTracker math, not a stub."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def version_ttft(self, version):
+        return telemetry.histogram(
+            "gateway_ttft_ms",
+            "Time to first token, submission to first on_token",
+            model=self.model, version=version)
+
+
+class _FakeFleet:
+    def __init__(self, model, replicas=2):
+        self.model = model
+        self.calls = []
+        self.version = "v0"
+        self._pending = None
+        self._n = 0
+        self._replicas = replicas
+        self._gw = _FakeGw(model)
+
+    def _entry(self, model):
+        class _E:
+            class spec:
+                slo = None
+        return _E()
+
+    def attach_flywheel(self, model, controller):
+        self.fly = controller
+
+    def gateway(self, model):
+        return self._gw
+
+    def canary_swap(self, model, *, params, fraction, drain_timeout_s):
+        self._n += 1
+        self._pending = f"v{self._n}"
+        self.calls.append(("canary", self._pending, params))
+        n = max(1, int(round(fraction * self._replicas)))
+        return {"model": model, "version": self._pending,
+                "from_version": self.version, "canaries": n,
+                "of": self._replicas, "swapped": n,
+                "still_draining": []}
+
+    def promote(self, model, *, drain_timeout_s):
+        self.calls.append(("promote", self._pending))
+        self.version = self._pending
+        return {"model": model, "version": self.version, "swapped": 1,
+                "still_draining": []}
+
+    def rollback(self, model, *, reason, drain_timeout_s):
+        self.calls.append(("rollback", reason))
+        return {"model": model, "version": self.version,
+                "from_version": self._pending, "reason": reason,
+                "swapped": 1, "still_draining": []}
+
+
+def test_flywheel_state_machine_full_cycle(tmp_path):
+    """Every controller decision on a fake fleet + fake clock: torn
+    pointer skipped, gate veto and torn candidate rejected WITHOUT
+    touching the pool, canary → clean hold → promote, burn breach →
+    rollback, anomaly spike → rollback, spent budget → HALT (new
+    publishes ignored, last-good keeps serving). Each outcome is
+    counted in ``fleet_candidates_total{model,result}``."""
+    reg = telemetry.registry()
+    model = "fwsm"
+    c0 = {r: reg.value("fleet_candidates_total", model=model, result=r)
+          for r in ("canaried", "promoted", "rolled_back",
+                    "rejected_torn", "rejected_gate", "torn_pointer")}
+    d = str(tmp_path)
+    now = [0.0]
+    torn_steps, vetoed_steps = {4}, {2}
+
+    def loader(ptr):
+        if ptr["step"] in torn_steps:
+            raise IOError("chaos: torn candidate")
+        return {"weights": ptr["step"]}
+
+    def gate(ptr, cand):
+        return ptr["step"] not in vetoed_steps
+
+    fleet = _FakeFleet(model)
+    fly = FlywheelController(
+        fleet, model, d, load_candidate=loader, eval_gate=gate,
+        canary_fraction=0.5, hold_ticks=2, burn_high=1.0,
+        max_rollbacks=2, anomaly_budget=1, poll_s=0.01,
+        slo={"ttft_ms": 10.0}, clock=lambda: now[0])
+    assert fleet.fly is fly            # attach_flywheel ran
+
+    assert fly.tick() == []            # nothing published yet
+    # torn POINTER: skipped, no pool calls
+    with open(checkpoint.published_path(d), "wb") as f:
+        f.write(b"torn by chaos")
+    fly.tick()
+    assert fleet.calls == [] and fly.phase == "idle"
+    assert reg.value("fleet_candidates_total", model=model,
+                     result="torn_pointer") - c0["torn_pointer"] == 1
+
+    # gate veto: pointer consumed (seq advances), pool untouched
+    checkpoint.publish_pointer(d, 2, seq=1)
+    fly.tick()
+    assert fly.seen_seq == 1 and fleet.calls == []
+    assert fly.tick() == []            # same seq: no re-consideration
+    assert reg.value("fleet_candidates_total", model=model,
+                     result="rejected_gate") - c0["rejected_gate"] == 1
+
+    # torn CANDIDATE (pointer fine, checkpoint dead): rejected loudly
+    checkpoint.publish_pointer(d, 4, seq=2)
+    fly.tick()
+    assert fly.seen_seq == 2 and fleet.calls == []
+    assert reg.value("fleet_candidates_total", model=model,
+                     result="rejected_torn") - c0["rejected_torn"] == 1
+
+    # clean candidate: canary, then a clean hold window promotes
+    checkpoint.publish_pointer(d, 6, seq=3)
+    fly.tick()
+    assert fly.phase == "canary"
+    assert fleet.calls[-1][:2] == ("canary", "v1")
+    assert fly.canary["canaries"] == 1 and fly.canary["of"] == 2
+    now[0] += 1.0
+    assert fly.tick() == []            # clean tick 1 of 2
+    now[0] += 1.0
+    fly.tick()                         # clean tick 2: promote
+    assert fly.phase == "idle" and fleet.version == "v1"
+    assert fleet.calls[-1] == ("promote", "v1")
+    assert reg.value("fleet_candidates_total", model=model,
+                     result="promoted") - c0["promoted"] == 1
+
+    # burn breach: the canary version's SLO split trips rollback
+    checkpoint.publish_pointer(d, 8, seq=4)
+    fly.tick()
+    assert fly.phase == "canary"
+    for _ in range(5):
+        fleet._gw.version_ttft("v2").observe(5000.0)
+    now[0] += 1.0
+    fly.tick()
+    assert fly.phase == "idle" and fly.rollbacks == 1
+    assert fleet.calls[-1] == ("rollback", "slo_burn")
+    assert not fly.halted
+
+    # anomaly spike: Perfscope step anomalies beyond the budget
+    checkpoint.publish_pointer(d, 10, seq=5)
+    fly.tick()
+    assert fly.phase == "canary"
+    telemetry.counter(
+        "step_anomalies_total",
+        "Steps beyond median + k*MAD of the program's rolling window",
+        program=model).inc(2)          # budget is 1
+    now[0] += 1.0
+    fly.tick()
+    assert fleet.calls[-1] == ("rollback", "anomaly")
+    assert fly.rollbacks == 2 and fly.halted   # budget spent: HALT
+
+    # halted: new publishes are ignored, last-good keeps serving
+    checkpoint.publish_pointer(d, 12, seq=6)
+    assert fly.tick() == []
+    assert fly.seen_seq == 5 and fleet.version == "v1"
+    assert reg.value("fleet_candidates_total", model=model,
+                     result="canaried") - c0["canaried"] == 3
+    assert reg.value("fleet_candidates_total", model=model,
+                     result="rolled_back") - c0["rolled_back"] == 2
+    desc = fly.describe()
+    assert desc["halted"] and desc["rollbacks"] == 2
+    assert any(h["action"] == "halt" for h in desc["history"])
+
+
+# ---------------------------------------------------------------------------
+# chip lending: the TrainingTenant under fake-clock arbitration
+# ---------------------------------------------------------------------------
+class _FakePool:
+    def __init__(self, size, lo=1, hi=4):
+        self.size = size
+        self.min_replicas = lo
+        self.max_replicas = hi
+        self.chips_per_replica = 1
+
+    def scale_to(self, n):
+        self.size = n
+        return n
+
+
+class _FakeEntry:
+    def __init__(self, pool):
+        self.pool = pool
+        self.gateway = None
+
+
+def test_training_tenant_preempt_and_borrow():
+    """Both lending directions, deterministically: a burning serving
+    pool PREEMPTS the training tenant (no sustained-idle wait —
+    training time is the reserve capacity), and once serving goes
+    sustained-idle the hungry tenant borrows the chip back. The
+    ``fleet_chips_in_use`` ledger and ``fleet_chip_lends_total``
+    counters prove each move; the tenant at ``want`` reads occupied,
+    so the allocation is stable between bursts."""
+    reg = telemetry.registry()
+    lend0 = reg.value("fleet_chip_lends_total", tenant="tt",
+                      direction="lend")
+    bor0 = reg.value("fleet_chip_lends_total", tenant="tt",
+                     direction="borrow")
+    entries = {"srv": _FakeEntry(_FakePool(1, lo=1, hi=2))}
+    leases = []
+    tenant = TrainingTenant(
+        lambda chips, reason: leases.append((chips, reason)),
+        chips=2, want=2, min_chips=1, name="tt")
+    sig = {"srv": dict(pressure=5.0, occupancy=1.0, burn=2.0,
+                       queued=10.0)}
+    now = [0.0]
+    arb = FleetArbiter(
+        entries,
+        ArbiterPolicy(interval_s=0.1, cooldown_s=1.0,
+                      pressure_high=2.0, burn_high=1.0, idle_s=1.0),
+        clock=lambda: now[0],
+        signals=lambda n, e: (dict(sig[n],
+                                   size=float(entries[n].pool.size))
+                              if n in sig else e.signals()))
+    assert arb.budget == 1
+    arb.register("tt", tenant)
+    assert arb.budget == 3
+    with pytest.raises(ValueError, match="already has a tenant"):
+        arb.register("tt", tenant)
+
+    # serving burns, budget fully allocated, tenant at want (occupied,
+    # NOT idle): the preempt path takes the chip immediately
+    decisions = arb.tick()
+    assert [(d["model"], d["direction"], d["reason"])
+            for d in decisions] == [("tt", "down", "preempt->srv"),
+                                    ("srv", "up", "hot")]
+    assert leases == [(1, "arbiter-lend")]
+    assert entries["srv"].pool.size == 2 and tenant.size == 1
+    assert reg.value("fleet_chip_lends_total", tenant="tt",
+                     direction="lend") - lend0 == 1
+    assert reg.value("fleet_chips_in_use", model="srv") == 2
+    assert reg.value("fleet_chips_in_use", model="tt") == 1
+    assert reg.value("fleet_chips_free") == 0
+
+    # burst over: serving idles. One quiet tick must NOT donate (idle
+    # is not SUSTAINED idle), then the hungry tenant borrows it back.
+    sig["srv"].update(pressure=0.0, occupancy=0.0, burn=0.0,
+                      queued=0.0)
+    now[0] = 5.0                       # past cooldown; idle clock arms
+    assert arb.tick() == []
+    now[0] = 6.5                       # 1.5s sustained idle >= idle_s
+    decisions = arb.tick()
+    assert [(d["model"], d["direction"], d["reason"])
+            for d in decisions] == [("srv", "down", "yield->tt"),
+                                    ("tt", "up", "hot")]
+    assert leases[-1] == (2, "arbiter-borrow")
+    assert tenant.size == 2
+    assert reg.value("fleet_chip_lends_total", tenant="tt",
+                     direction="borrow") - bor0 == 1
+    assert reg.value("fleet_chips_in_use", model="tt") == 2
+
+    # stable: tenant at want is occupied, serving is at its floor —
+    # nothing oscillates
+    now[0] = 20.0
+    assert arb.tick() == []
+    now[0] = 30.0
+    assert arb.tick() == []
+    assert (entries["srv"].pool.size, tenant.size) == (1, 2)
+    assert arb.describe()["budget"] == 3
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /healthz causes + diagnose fleet|flywheel
+# ---------------------------------------------------------------------------
+def test_health_causes_and_diagnose_surfaces(cfg, params, tmp_path,
+                                             capsys):
+    """Fleet /healthz names WHY a model is degraded (slo_burn,
+    flywheel_halted, ...) and lists the degraded models at the top
+    level; ``diagnose.py fleet`` renders the causes and ``diagnose.py
+    flywheel`` renders the controller's phase, canary, per-version
+    burn and decision history from one /state + /metrics scrape."""
+    fleet = FleetGateway(
+        [ModelSpec("m", _fac(cfg, params), slo={"ttft_ms": 10.0})],
+        supervise=False)
+    try:
+        fly = FlywheelController(
+            fleet, "m", str(tmp_path),
+            load_candidate=lambda ptr: params,
+            canary_fraction=0.5, hold_ticks=2, poll_s=0.5,
+            slo={"ttft_ms": 10.0})
+        h = fleet.health()
+        assert h["status"] == "ok" and h["degraded"] == []
+        assert h["models"]["m"]["causes"] == []
+
+        # synthetic SLO burn -> the model reads degraded, with a cause
+        gw = fleet.gateway("m")
+        gw.slo.tick(force=True)
+        for _ in range(5):
+            gw._m_ttft.observe(5000.0)
+        gw.slo.tick(force=True)
+        h = fleet.health()
+        assert h["status"] == "degraded" and h["degraded"] == ["m"]
+        assert "slo_burn" in h["models"]["m"]["causes"]
+
+        # a halted flywheel is a health cause an operator sees
+        fly.halted = True
+        fly._note("halt", rollbacks=2, budget=2)
+        h = fleet.health()
+        assert "flywheel_halted" in h["models"]["m"]["causes"]
+        st = fleet.state()
+        assert st["flywheel"]["m"]["halted"]
+        assert st["models"]["m"]["canary"] is None
+
+        # the diagnose CLI renders both, from the live HTTP door
+        port = fleet.start_http(port=0)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "tools"))
+        import diagnose
+        assert diagnose.fleet_state(f"127.0.0.1:{port}")
+        out = capsys.readouterr().out
+        assert "degraded: m" in out
+        assert "slo_burn" in out and "flywheel_halted" in out
+        assert diagnose.flywheel_state(f"127.0.0.1:{port}")
+        out = capsys.readouterr().out
+        assert "phase=idle HALTED" in out
+        assert "halt:" in out
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the REAL loop end to end, under concurrent train + serve chaos
+# (the ci/runtime_functions.sh::flywheel_smoke bodies)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_flywheel_publish_canary_promote_under_chaos(cfg, params,
+                                                     params_b,
+                                                     tmp_path):
+    """The full promote cycle with BOTH chaos plans live: an elastic
+    trainer (2-host rendezvous) publishes on a cadence while a chaos
+    host kill forces an elastic resize mid-cadence; the controller
+    canaries the candidate into 1 of 3 replicas of a LIVE pool under
+    traffic; a chaos replica kill lands mid-canary on an incumbent
+    replica. Contract: zero accepted requests dropped, every streamed
+    token list bit-identical to a generate with the weights its
+    version label names, and a clean hold window promotes fleet-wide."""
+    by_version = {"v0": params, "v1": params_b}
+    prompt = [2, 4, 6, 8]
+    # every reference BEFORE the fleet exists (compile races)
+    refs = {(v, s): _reference(cfg, by_version[v], prompt, 12, seed=s,
+                               temperature=0.9)
+            for v in ("v0", "v1") for s in range(24)}
+
+    d = str(tmp_path / "ckpt")
+    coord = ElasticCoordinator(2, heartbeat_s=HB, lost_after_s=LOST,
+                               straggler_lag=0)
+    fleet = None
+    try:
+        sim = chaos.SimTrainHost("h1", coord.address, heartbeat_s=HB)
+        tj = threading.Thread(target=sim.join)
+        tj.start()
+        member = ElasticMember("h0", coord.address, heartbeat_s=HB)
+        member.join()
+        tj.join(timeout=10)
+        mgr = CheckpointManager(d, async_save=False)
+        tr = ElasticTrainer(lambda w: _make_program(w),
+                            JournaledData(_batch_fn), mgr,
+                            member=member, save_every=2,
+                            spike_window=0, publish_every=2)
+        tplan = chaos.attach_train(
+            tr, chaos.TrainChaosPlan(kill_host_at={"h1": 5}),
+            hosts={"h1": sim})
+        tr.pre_step_hooks.append(lambda i, b: time.sleep(HB))
+        tstats = {}
+        tthread = threading.Thread(
+            target=lambda: tstats.update(tr.run(30)))
+        tthread.start()
+
+        fleet = FleetGateway(
+            [ModelSpec("m", _fac(cfg, params), replicas=3,
+                       max_replicas=3,
+                       slo={"ttft_ms": 60000.0})],
+            supervisor_opts=SUPK)
+        # pre-warm every incumbent engine so cold compiles never stack
+        # on top of the canary surge (test_serve_chaos.py idiom)
+        for r in fleet.pool("m").replicas():
+            fleet.gateway("m").submit(
+                prompt, 2, seed=50,
+                prefer_replica=r.name).result(timeout=180)
+        fly = FlywheelController(
+            fleet, "m", d,
+            load_candidate=lambda ptr: (mgr.restore(int(ptr["step"])),
+                                        params_b)[1],
+            canary_fraction=0.34, hold_ticks=2, burn_high=50.0,
+            max_rollbacks=2, poll_s=0.5, slo={"ttft_ms": 60000.0},
+            anomaly_budget=10_000)   # compile spikes DO register as
+        # step anomalies on CPU; the anomaly-rollback path is pinned
+        # deterministically in test_flywheel_state_machine_full_cycle
+
+        # live traffic WHILE the trainer (and its chaos) runs
+        handles = [fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": i}) for i in range(6)]
+        tthread.join(timeout=120)
+        assert not tthread.is_alive()
+        assert tstats["resizes"] >= 1 and tstats["world"] == 1, tstats
+        assert tstats["published"] >= 2, tstats
+        assert tplan.injected["host_kill"] == 1
+
+        decisions = fly.tick()
+        assert fly.phase == "canary", decisions
+        can = dict(fly.canary)
+        assert (can["version"], can["canaries"], can["of"]) == \
+            ("v1", 1, 3)
+        # mid-canary: kill an INCUMBENT replica (its in-flight v0 work
+        # re-dispatches to the surviving v0 sibling, never v1)
+        reps = fleet.pool("m").replicas()
+        idx = next(i for i, r in enumerate(reps)
+                   if r.version == "v0")
+        splan = chaos.attach_serve(fleet.pool("m"), chaos.ServeChaosPlan(
+            seed=7,
+            kill_replica={idx: reps[idx].engine.steps_run + 4}))
+        handles += [fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": 10 + i}) for i in range(8)]
+        deadline = time.monotonic() + 120
+        while (splan.injected["replica_kill"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert splan.injected["replica_kill"] == 1, splan.injected
+
+        time.sleep(0.2)
+        assert fly.tick() == []        # clean tick 1 of 2
+        time.sleep(0.2)
+        fly.tick()                     # clean tick 2: promote
+        assert fly.phase == "idle"
+        assert fleet.pool("m").version == "v1"
+
+        # zero dropped + per-version bit-identity for EVERYTHING
+        for i, h in enumerate(handles):
+            toks = list(h.result(timeout=180))
+            assert h.reason == "complete", (i, h.reason)
+            assert h.version in by_version, (i, h.version)
+            assert toks == refs[(h.version,
+                                 i if i < 6 else 10 + i - 6)], \
+                (i, h.version)
+        # post-promote: uniformly the candidate build (retire any
+        # old-build replica a supervisor respawn raced in)
+        for r in fleet.pool("m").replicas():
+            if r.version != "v1":
+                fleet.pool("m").drain_replica(r)
+        h = fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": 20})
+        assert h.result(timeout=180) is not None
+        assert h.version == "v1"
+        assert list(h.tokens) == refs[("v1", 20)] or \
+            list(h.result(timeout=1)) == refs[("v1", 20)]
+        hist = [e["action"] for e in fly.history]
+        assert "canary" in hist and "promote" in hist
+        mgr.close()
+        member.leave()
+    finally:
+        if fleet is not None:
+            fleet.close()
+        coord.close()
+        gc.collect()
+
+
+@pytest.mark.slow
+def test_flywheel_breach_rollback_under_chaos(cfg, params, params_b,
+                                              tmp_path):
+    """The full rollback cycle with BOTH chaos plans live: the trainer
+    publishes a TORN candidate (chaos tears the checkpoint after the
+    pointer commits) which the controller rejects without touching
+    live traffic; the next good candidate canaries under traffic with
+    a chaos replica kill; the canary version's SLO burn breaches and
+    the controller auto-rolls-back to last-good within budget. Every
+    request — before, during, after — finishes bit-identically on the
+    build that seated it."""
+    reg = telemetry.registry()
+    rb0 = reg.value("fleet_rollback_total", model="m",
+                    reason="slo_burn")
+    by_version = {"v0": params, "v1": params_b}
+    prompt = [2, 4, 6, 8]
+    refs = {(v, s): _reference(cfg, by_version[v], prompt, 12, seed=s,
+                               temperature=0.9)
+            for v in ("v0", "v1") for s in range(24)}
+
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(1),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=2, spike_window=0, publish_every=2)
+    tplan = chaos.attach_train(
+        tr, chaos.TrainChaosPlan(torn_checkpoint_at=2))
+    torn_handled = threading.Event()
+    # a non-None hook return REPLACES the batch — discard wait()'s bool
+    tr.pre_step_hooks.append(
+        lambda i, b: (torn_handled.wait(timeout=60), None)[1]
+        if i == 3 else None)
+    tstats = {}
+    tthread = threading.Thread(target=lambda: tstats.update(tr.run(4)))
+    tthread.start()
+
+    fleet = FleetGateway(
+        [ModelSpec("m", _fac(cfg, params), replicas=2,
+                   max_replicas=2, slo={"ttft_ms": 60000.0})],
+        supervisor_opts=SUPK)
+    try:
+        def load_candidate(ptr):
+            mgr.restore(int(ptr["step"]))    # raises on torn
+            return params_b
+
+        fly = FlywheelController(
+            fleet, "m", d, load_candidate=load_candidate,
+            canary_fraction=0.5, hold_ticks=10, burn_high=1.0,
+            max_rollbacks=2, poll_s=0.5, slo={"ttft_ms": 10.0},
+            anomaly_budget=10_000)   # see the promote test: compile
+        # spikes register as real anomalies; we want slo_burn here
+
+        # pre-canary traffic + a chaos replica kill (supervised
+        # respawn; re-dispatch stays on the v0 build)
+        reps = fleet.pool("m").replicas()
+        gw = fleet.gateway("m")
+        for r in reps:                  # pre-warm both engines
+            gw.submit(prompt, 2, seed=50,
+                      prefer_replica=r.name).result(timeout=180)
+        handles = [fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": i}) for i in range(6)]
+        splan = chaos.attach_serve(fleet.pool("m"), chaos.ServeChaosPlan(
+            seed=9,
+            kill_replica={0: reps[0].engine.steps_run + 4}))
+
+        # the TORN candidate arrives first: rejected, pool untouched
+        deadline = time.monotonic() + 60
+        while (checkpoint.read_published(d) is None
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        ptr = checkpoint.read_published(d)
+        assert ptr is not None and ptr["seq"] == 1, ptr
+        fly.tick()
+        assert fly.phase == "idle" and fly.canary is None
+        assert fleet.pool("m").version == "v0"
+        assert fly.seen_seq == 1
+        assert tplan.injected["torn_checkpoint"] == 1
+        torn_handled.set()
+        tthread.join(timeout=120)
+        assert not tthread.is_alive()
+        assert tstats["published"] == 2, tstats
+
+        # the good candidate canaries under the same live traffic
+        fly.tick()
+        assert fly.phase == "canary"
+        assert fly.canary["version"] == "v1"
+        handles += [fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": 10 + i}) for i in range(6)]
+
+        # the canary version BURNS (synthetic, like the gateway shed
+        # tests): the controller rolls back to last-good
+        for _ in range(5):
+            gw.version_ttft("v1").observe(5000.0)
+        time.sleep(0.1)
+        fly.tick()
+        assert fly.phase == "idle" and fly.rollbacks == 1
+        assert not fly.halted          # within budget
+        assert fleet.pool("m").version == "v0"
+        assert reg.value("fleet_rollback_total", model="m",
+                         reason="slo_burn") - rb0 == 1
+        rb = next(e for e in fly.history if e["action"] == "rollback")
+        assert rb["reason"] == "slo_burn" and rb["budget_left"] == 1
+
+        # zero dropped; every request finished on the build that
+        # seated it, bit-identically — through kill, canary, rollback
+        assert splan.injected["replica_kill"] == 1, splan.injected
+        for i, h in enumerate(handles):
+            toks = list(h.result(timeout=180))
+            assert h.reason == "complete", (i, h.reason)
+            assert toks == refs[(h.version, i if i < 6 else 10 + i - 6)
+                                ], (i, h.version)
+        # post-rollback: the pool serves last-good uniformly
+        for r in fleet.pool("m").replicas():
+            if r.version != "v0":
+                fleet.pool("m").drain_replica(r)
+        h = fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": 21})
+        assert list(h.result(timeout=180)) == refs[("v0", 21)]
+        assert h.version == "v0"
+    finally:
+        mgr.close()
+        fleet.close()
+        gc.collect()
+
+
+@pytest.mark.slow
+def test_chip_lending_e2e_trainer_and_fleet(cfg, params, tmp_path):
+    """Train/serve chip lending END TO END: a live elastic trainer
+    registers as an arbiter tenant; the sustained-idle serving pool's
+    chip is borrowed by the hungry trainer (elastic lease resize,
+    generation bump, ZERO replayed batches), then a traffic burst
+    preempts the loan back and the pool grows to drain it. Both moves
+    land in ``fleet_chip_lends_total`` and the ``fleet_chips_in_use``
+    ledger; serving stays bit-identical throughout."""
+    reg = telemetry.registry()
+    lend0 = reg.value("fleet_chip_lends_total", tenant="train",
+                      direction="lend")
+    bor0 = reg.value("fleet_chip_lends_total", tenant="train",
+                     direction="borrow")
+    prompt = [2, 4, 6, 8]
+    refs = [_reference(cfg, params, prompt, 12, seed=i,
+                       temperature=0.9) for i in range(12)]
+
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    tr = ElasticTrainer(lambda w: _make_program(w),
+                        JournaledData(_batch_fn), mgr,
+                        save_every=50, spike_window=0)
+    hold = threading.Event()
+    tr.pre_step_hooks.append(
+        lambda i, b: (time.sleep(0.005),
+                      hold.wait(timeout=90) if i == 550 else None)[0])
+    tstats = {}
+    tthread = threading.Thread(target=lambda: tstats.update(tr.run(600)))
+
+    fleet = FleetGateway(
+        [ModelSpec("m", _fac(cfg, params), replicas=2,
+                   min_replicas=1, max_replicas=2,
+                   slo={"ttft_ms": 60000.0})],
+        arbiter=ArbiterPolicy(interval_s=0.05, cooldown_s=0.3,
+                              pressure_high=2.0, burn_high=100.0,
+                              occupancy_low=0.5, idle_s=0.15),
+        supervise=False)
+    try:
+        tenant = TrainingTenant(
+            lambda chips, reason: tr.request_world(chips, reason),
+            chips=1, want=2, min_chips=1, max_chips=2, name="train")
+        fleet.register_tenant(tenant)
+        assert fleet.arbiter.budget == 3
+        tthread.start()
+
+        # phase 1 — BORROW: serving is idle; after sustained idle its
+        # spare replica yields and the hungry trainer takes the chip
+        deadline = time.monotonic() + 60
+        while (reg.value("fleet_chip_lends_total", tenant="train",
+                         direction="borrow") - bor0 < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert reg.value("fleet_chip_lends_total", tenant="train",
+                         direction="borrow") - bor0 >= 1, \
+            fleet.arbiter.describe()
+        assert tenant.size == 2
+        assert fleet.pool("m").size == 1
+        assert reg.value("fleet_chips_in_use", model="train") == 2
+
+        # the trainer actually applies the lease (generation bump,
+        # world 2) at a step boundary
+        deadline = time.monotonic() + 60
+        while (tr._stats["lease_resizes"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert tr._stats["lease_resizes"] >= 1
+
+        # phase 2 — PREEMPT: a burst builds real queue pressure on the
+        # shrunken pool; the arbiter takes the tenant's chip back and
+        # the pool grows to drain the backlog
+        time.sleep(0.4)                # clear the borrow cooldown
+        handles = [fleet.submit_dict(
+            {"model": "m", "prompt": prompt, "max_new_tokens": 12,
+             "temperature": 0.9, "seed": i}) for i in range(12)]
+        deadline = time.monotonic() + 60
+        while (reg.value("fleet_chip_lends_total", tenant="train",
+                         direction="lend") - lend0 < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert reg.value("fleet_chip_lends_total", tenant="train",
+                         direction="lend") - lend0 >= 1, \
+            fleet.arbiter.describe()
+        hold.set()
+        for i, h in enumerate(handles):
+            assert list(h.result(timeout=180)) == refs[i], i
+            assert h.version == "v0"
+
+        tthread.join(timeout=180)
+        assert not tthread.is_alive()
+        # the lease path is cooperative: save-then-move, so NOTHING
+        # was replayed and every batch position is accounted for
+        assert tstats["steps"] == 600
+        assert tstats["lease_resizes"] >= 2, tstats
+        assert tstats["replayed"] == 0, tstats
+        assert tr.data.cursor == 600
+        assert reg.value("elastic_resizes_total", reason="lease") >= 2
+        desc = fleet.arbiter.describe()
+        assert any(dd["reason"].startswith("preempt->")
+                   or dd["reason"].startswith("yield->")
+                   for dd in desc["decisions"]), desc
+    finally:
+        hold.set()
+        mgr.close()
+        fleet.close()
+        gc.collect()

@@ -24,7 +24,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from dataclasses import replace
 
 from mxtpu import rpc, telemetry
 from mxtpu.models import llama
@@ -34,24 +33,24 @@ from mxtpu.serve.gateway import (AutoscalePolicy, Autoscaler,
                                  KVChannel, ReplicaSet)
 
 
-@pytest.fixture(scope="module")
-def cfg():
-    return replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
-                   remat=False, attn_impl="dense")
+import llama_refs
 
 
 @pytest.fixture(scope="module")
-def params(cfg):
-    return llama.init_params(cfg, jax.random.PRNGKey(0))
+def cfg(serve_cfg):
+    return serve_cfg
+
+
+@pytest.fixture(scope="module")
+def params(serve_params):
+    return serve_params
 
 
 def _reference(cfg, params, prompt, mnew, seed=0, temperature=0.0,
                top_k=None, top_p=None):
-    out = llama.generate(
-        cfg, params, jnp.asarray(prompt, jnp.int32)[None], mnew,
-        temperature=temperature, top_k=top_k, top_p=top_p,
-        rng=jax.random.PRNGKey(seed))
-    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+    return llama_refs.reference(cfg, params, prompt, mnew, seed=seed,
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p)
 
 
 # ---------------------------------------------------------------------------
@@ -381,6 +380,8 @@ def test_prefill_detached_inject_bit_identical(cfg, params):
         assert eng.compile_count <= eng.n_buckets + 1
 
 
+@pytest.mark.slow   # ~19s; gateway_smoke covers the fresh-process
+# path and tier-1 keeps test_gateway_two_replicas_poisson_bit_identical
 def test_disagg_gateway_bit_identical_over_rpc_channel(cfg, params):
     """End to end: prompts routed to prefill workers, KV blocks framed
     over the mxtpu.rpc channel (HMAC on), seated in decode replicas —
@@ -644,6 +645,7 @@ def test_interval_p99_windows():
 # ---------------------------------------------------------------------------
 # bench path
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # ~10s; bench_smoke runs this path fresh-process
 def test_bench_gateway_smoke(cfg):
     """The gateway benchmark's measurement path on a tiny config:
     record shape, positive throughput, ordered percentiles, and a TTFT

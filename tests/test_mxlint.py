@@ -155,7 +155,9 @@ def test_cli_deep_and_sarif(tmp_path):
         assert rid in listed.stdout
 
 
-@pytest.mark.parametrize("fname", sorted(os.listdir(DEEP_FIXTURES)))
+@pytest.mark.parametrize("fname",
+                         sorted(f for f in os.listdir(DEEP_FIXTURES)
+                                if f.endswith(".py")))
 def test_deep_fixture_findings_match_markers_exactly(fname):
     """Each deep fixture is flagged at EXACTLY its ``# seeded:``
     markers by the union of the base and deep passes — 100% recall on

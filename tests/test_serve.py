@@ -17,22 +17,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from dataclasses import replace
 
 from mxtpu.models import llama
 from mxtpu.ops.attention import dense_attention, slot_decode_attention
 from mxtpu.serve import Request, ServeEngine, bucket_for
 
 
-@pytest.fixture(scope="module")
-def cfg():
-    return replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
-                   remat=False, attn_impl="dense")
+import llama_refs
 
 
 @pytest.fixture(scope="module")
-def params(cfg):
-    return llama.init_params(cfg, jax.random.PRNGKey(0))
+def cfg(serve_cfg):
+    return serve_cfg
+
+
+@pytest.fixture(scope="module")
+def params(serve_params):
+    return serve_params
 
 
 # ---------------------------------------------------------------------------
@@ -132,14 +133,14 @@ def _poisson_requests(cfg, n, seed, *, mixed_sampling):
 
 
 def _reference(cfg, params, req):
-    out = llama.generate(
-        cfg, params, jnp.asarray(req.prompt, jnp.int32)[None],
-        req.max_new_tokens, temperature=req.temperature,
-        top_k=req.top_k, top_p=req.top_p,
-        rng=jax.random.PRNGKey(req.seed))
-    return np.asarray(out)[0, len(req.prompt):]
+    return np.asarray(llama_refs.reference(
+        cfg, params, req.prompt, req.max_new_tokens, seed=req.seed,
+        temperature=req.temperature, top_k=req.top_k,
+        top_p=req.top_p))
 
 
+@pytest.mark.slow   # ~21s; serve_smoke proves the fresh-process
+# bit-check and tier-1 keeps test_serve_scheduling_never_changes_tokens
 def test_serve_bit_identical_to_generate_poisson_stream(cfg, params):
     """>= 12 requests, seeded Poisson arrivals, mixed prompt/output
     lengths AND mixed per-request sampling configs: the continuous-
@@ -207,6 +208,7 @@ def test_serve_compile_count_bounded_churn(cfg, params):
     assert eng._decode._cache_size() == 1
 
 
+@pytest.mark.slow   # ~14s; ci_all's full tier reruns it every CI
 def test_serve_int8_rides_the_same_programs(cfg, params):
     """The weight-only int8 tree serves through the identical engine
     path (same program count) and matches generate over the same
@@ -263,6 +265,7 @@ def test_bucket_policy():
         bucket_for(65, 4, 64)
 
 
+@pytest.mark.slow   # ~7s; bench_smoke runs this path fresh-process
 def test_bench_serve_smoke(cfg):
     """The serve benchmark's measurement path (the metric the chip run
     emits) runs end to end on a tiny config: record shape, positive
@@ -296,6 +299,8 @@ def test_gluon_llama_serve(cfg, params):
     np.testing.assert_array_equal(res[rid], ref)
 
 
+@pytest.mark.slow   # ~12s; telemetry_smoke + test_telemetry.py keep
+# the scrape contract in tier-1; ci_all's full tier reruns this one
 def test_serve_telemetry_counters_spans_and_threads(cfg, params):
     """ISSUE 5: the engine feeds the process-wide registry without
     changing tokens, and the counters stay EXACT when two engines run

@@ -42,7 +42,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from dataclasses import replace
 
 from mxtpu import rpc, telemetry
 from mxtpu.contrib.chaos import ServeChaosPlan, attach_serve
@@ -58,24 +57,24 @@ SUP = dict(heartbeat_s=0.05, stall_s=30.0, backoff_base_s=0.01,
            backoff_max_s=0.05)
 
 
-@pytest.fixture(scope="module")
-def cfg():
-    return replace(llama.CONFIGS["tiny"], dtype=jnp.float32,
-                   remat=False, attn_impl="dense")
+import llama_refs
 
 
 @pytest.fixture(scope="module")
-def params(cfg):
-    return llama.init_params(cfg, jax.random.PRNGKey(0))
+def cfg(serve_cfg):
+    return serve_cfg
+
+
+@pytest.fixture(scope="module")
+def params(serve_params):
+    return serve_params
 
 
 def _reference(cfg, params, prompt, mnew, seed=0, temperature=0.0,
                top_k=None, top_p=None):
-    out = llama.generate(
-        cfg, params, jnp.asarray(prompt, jnp.int32)[None], mnew,
-        temperature=temperature, top_k=top_k, top_p=top_p,
-        rng=jax.random.PRNGKey(seed))
-    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+    return llama_refs.reference(cfg, params, prompt, mnew, seed=seed,
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p)
 
 
 def _engine(cfg, params, **kw):
@@ -113,6 +112,9 @@ def test_resume_key_replays_sampling_chain(cfg, params):
 # ---------------------------------------------------------------------------
 # tentpole (a)+(b): supervision + deterministic re-dispatch
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # ~27s; runs in chaos_serve (+x3 flakiness) and
+# by node id in lockcheck_smoke — tier-1 keeps the single-kill and
+# resume_key re-dispatch gates
 def test_replica_kill_poisson_stream_bit_identical(cfg, params):
     """THE acceptance gate: a seeded multi-client Poisson stream
     through a 2-replica HTTP gateway with a chaos-killed replica —
@@ -457,6 +459,7 @@ def test_breaker_trips_to_bit_identical_colocated_fallback(cfg,
         gw.close()
 
 
+@pytest.mark.slow   # ~31s; runs in chaos_serve (+x3 flakiness)
 def test_disagg_chaos_stream_bit_identical_over_tcp(cfg, params):
     """THE disagg acceptance gate: a seeded client stream through
     disaggregated prefill/decode over an HMAC TCP channel, with an

@@ -94,6 +94,7 @@ def test_opperf_runs():
     assert "relu" in out.stdout
 
 
+@pytest.mark.slow   # ~7s; dist_tests runs test_tools.py in full
 def test_im2rec_exists_and_diagnose():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "diagnose.py")],

@@ -193,6 +193,23 @@ def fleet_state(addr: str = ""):
     for row in lines:
         print("  ".join(c.ljust(w)
                         for c, w in zip(row, widths)).rstrip())
+    # per-model degraded causes from /healthz (breaker open, supervisor
+    # exhausted, SLO burn, active rollback, ...) — the aggregate view
+    # the fleet health endpoint computes, not re-derived here
+    try:
+        hstatus, health = cli.get_json("/healthz")
+    except Exception:
+        hstatus, health = 0, {}
+    if hstatus in (200, 503) and isinstance(health, dict):
+        degraded = health.get("degraded") or []
+        if degraded:
+            print(f"degraded: {', '.join(sorted(degraded))}")
+            for name in sorted(degraded):
+                h = (health.get("models") or {}).get(name) or {}
+                causes = h.get("causes") or []
+                print(f"  {name}: {', '.join(causes) or '(unknown)'}")
+        else:
+            print("degraded: (none)")
     arb = state.get("arbiter")
     if arb:
         print(f"arbiter: budget={arb['budget']} free={arb['free']} "
@@ -206,6 +223,87 @@ def fleet_state(addr: str = ""):
                     for (_, lab) in samples
                     if dict(lab).get("process")})
     print(f"federated processes: {', '.join(procs) or '(local only)'}")
+    return True
+
+
+def flywheel_state(addr: str = ""):
+    """``python tools/diagnose.py flywheel <host:port>`` — the
+    continuous-deployment loop at a glance, from ONE /state + ONE
+    /metrics scrape: per attached :class:`FlywheelController` the
+    phase (idle/canary/halted), the last candidate seen, the live
+    canary split (replicas on the candidate vs pool size), per-version
+    SLO burn, the rollback budget, and the last decisions with their
+    reasons (``MXTPU_GATEWAY_ADDR=host:port``, or pass the address)."""
+    addr = addr or os.environ.get("MXTPU_GATEWAY_ADDR", "")
+    if not addr:
+        return False
+    host, _, port = addr.partition(":")
+    print(f"----------Flywheel state ({addr})----------")
+    try:
+        from mxtpu.serve.gateway import GatewayClient
+        cli = GatewayClient(host, int(port or 9300), timeout=5.0)
+        status, state = cli.get_json("/state")
+        mstatus, text = cli.get_text("/metrics")
+    except Exception as e:
+        print(f"unreachable: {e!r}")
+        return False
+    if status != 200 or mstatus != 200:
+        print(f"HTTP {status}/{mstatus}: {state}")
+        return False
+    flys = state.get("flywheel")
+    if not isinstance(flys, dict) or not flys:
+        print("no flywheel controllers attached "
+              "(FleetGateway.attach_flywheel / FlywheelController)")
+        return False
+    from mxtpu import telemetry
+    try:
+        samples = telemetry.parse_prometheus(text)["samples"]
+    except ValueError as e:
+        print(f"malformed /metrics scrape: {e}")
+        return False
+    # per-(model, version) burn from the scrape — covers builds whose
+    # in-process tracker state the /state block no longer carries
+    vburn = {}
+    for (name, labels), value in samples.items():
+        d = dict(labels)
+        if "process" in d:
+            continue
+        if (name == "mxtpu_gateway_slo_burn_rate"
+                and "model" in d and "version" in d):
+            key = (d["model"], d["version"])
+            vburn[key] = max(vburn.get(key, 0.0), value)
+    for name, fly in sorted(flys.items()):
+        phase = fly.get("phase", "?")
+        if fly.get("halted"):
+            phase += " HALTED"
+        print(f"{name}: phase={phase} seen_seq={fly.get('seen_seq')} "
+              f"fraction={fly.get('fraction')} "
+              f"hold_ticks={fly.get('hold_ticks')} "
+              f"burn_high={fly.get('burn_high')} "
+              f"rollbacks={fly.get('rollbacks')}"
+              f"/{fly.get('max_rollbacks')}")
+        can = fly.get("canary")
+        if can:
+            print(f"  canary: {can.get('version')} on "
+                  f"{can.get('canaries')}/{can.get('of')} replicas "
+                  f"(from {can.get('from_version')}, "
+                  f"clean_ticks={can.get('clean_ticks')})")
+        burns = dict(fly.get("burn") or {})
+        for (m, ver), v in vburn.items():
+            if m == name and ver not in burns:
+                burns[ver] = v
+        for ver in sorted(burns):
+            b = burns[ver]
+            print(f"  burn[{ver}]: "
+                  f"{'-' if b is None else format(b, '.3f')}")
+        hist = fly.get("history") or []
+        if hist:
+            print("  decisions:")
+        for h in hist:
+            extra = " ".join(
+                f"{k}={v}" for k, v in sorted(h.items())
+                if k not in ("action", "model", "t"))
+            print(f"    {h.get('action')}: {extra}")
     return True
 
 
@@ -598,6 +696,13 @@ def main():
                   "MXTPU_GATEWAY_ADDR)")
             sys.exit(2)
         sys.exit(0 if fleet_state(addr) else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "flywheel":
+        addr = sys.argv[2] if len(sys.argv) > 2 else ""
+        if not addr and not os.environ.get("MXTPU_GATEWAY_ADDR"):
+            print("usage: diagnose.py flywheel <host:port>  (or set "
+                  "MXTPU_GATEWAY_ADDR)")
+            sys.exit(2)
+        sys.exit(0 if flywheel_state(addr) else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "elastic":
         addr = sys.argv[2] if len(sys.argv) > 2 else ""
         if not addr and not os.environ.get("MXTPU_ELASTIC_COORD_ADDR"):
