@@ -157,6 +157,104 @@ print(f"serve_smoke: OK ({len(reqs)} requests, "
 PYEOF
 }
 
+paged_kv_smoke() {
+    # paged KV cache with CoW prefix sharing end to end on CPU
+    # (docs/serving.md §Paged KV cache): a shared-system-prompt burst
+    # through a paged ServeEngine sized so the POOL (not slots) is the
+    # admission bound — every stream must stay bit-identical to
+    # generate (zero drops, backpressure only), prefix hits and the
+    # boundary-page CoW fork must actually fire, and the paged pool
+    # must reach higher slot concurrency than the dense bank it
+    # replaced. Then one paged disagg handoff over the page-granular
+    # wire. The full contract is tier-1 in tests/test_paged_kv.py;
+    # this stage proves it in a fresh process with no pytest fixtures.
+    python - << 'PYEOF'
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import threading
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+import jax.numpy as jnp
+from dataclasses import replace
+from mxtpu.models import llama
+from mxtpu.serve import Request, ServeEngine
+
+cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32, remat=False,
+              attn_impl="dense")
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+def ref(prompt, mnew, seed):
+    out = llama.generate(cfg, params,
+                         jnp.asarray(prompt, jnp.int32)[None], mnew,
+                         temperature=1.0, rng=jax.random.PRNGKey(seed))
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+# 4 slots over a pool that holds only ~2 dense slots' worth of pages:
+# the burst must queue on pages, drop nothing, and share the prefix
+shared = [7, 3, 9, 1, 5, 2, 8, 4, 6]          # 9 toks, ps=8 -> fork
+eng = ServeEngine(cfg, params, max_slots=4, max_len=32, min_bucket=4,
+                  paged=True, page_size=8, n_pages=9)
+rng = np.random.default_rng(7)
+reqs = [(shared + list(rng.integers(0, cfg.vocab_size, 1 + i % 3)),
+         int(rng.choice([2, 4, 6])), i) for i in range(6)]
+rids = [eng.submit(Request(prompt=p, max_new_tokens=m,
+                           temperature=1.0, seed=s))
+        for (p, m, s) in reqs]
+peak = {"active": 0}
+stop = threading.Event()
+def poll():
+    while not stop.wait(0.004):
+        peak["active"] = max(peak["active"],
+                             eng.kv_cache_stats()["active"])
+t = threading.Thread(target=poll, daemon=True); t.start()
+res = eng.run()
+stop.set(); t.join(2)
+for rid, (p, m, s) in zip(rids, reqs):
+    got = [int(x) for x in res[rid]]
+    assert got == ref(p, m, s), (rid, got, ref(p, m, s))  # zero drops
+st = eng.kv_cache_stats()
+assert st["prefix_hits"] >= 1 and st["cow_forks"] >= 1, st
+assert st["pages_used"] > 0 and st["active"] == 0, st   # drained
+# pool of 8 usable pages = 2 dense slots' worth; sharing + paging
+# must have run MORE than 2 streams concurrently at some point
+assert peak["active"] > 2, peak
+assert eng.compile_count <= eng.n_buckets + 2, \
+    (eng.compile_count, eng.n_buckets)
+
+# one paged disagg handoff over the page-granular wire + journal
+from mxtpu.serve.gateway.disagg import DisaggBackend
+be = DisaggBackend(cfg, params, n_prefill=1, n_decode=1, max_slots=2,
+                   max_len=32, min_bucket=4, paged=True, page_size=8)
+try:
+    toks, done = [], threading.Event()
+    p1 = shared + [11, 12]
+    be.route(Request(prompt=p1, max_new_tokens=4, temperature=1.0,
+                     seed=0,
+                     on_token=lambda rid, t: toks.append(int(t)),
+                     on_done=lambda rid, r: done.set()))
+    assert done.wait(120) and toks == ref(p1, 4, 0), toks
+    assert int(be._m_page_frames.value) >= 2   # 11 toks / ps 8
+    assert len(be._journal) == 1
+finally:
+    be.close()
+print(f"paged_kv_smoke: OK ({len(reqs)} shared-prefix requests, "
+      f"peak {peak['active']} active on a 2-dense-slot pool, "
+      f"{st['prefix_hits']} prefix hits, {st['cow_forks']} CoW forks, "
+      f"paged disagg handoff journaled)")
+PYEOF
+}
+
+paged_kv_slow() {
+    # the slow-marked paged heavies (engine bit-exactness with prefix
+    # sharing, pool-exhaustion backpressure, int8 pool determinism,
+    # the full disagg wire/journal contract) — tier-1 skips slow
+    # markers to stay inside its budget, so this stage is their
+    # dedicated CI home (ci_all's unittest_cpu_mesh also runs them)
+    python -m pytest tests/test_paged_kv.py -x -q -m slow "$@"
+}
+
 gateway_smoke() {
     # the serving TIER end to end in a fresh process (docs/serving.md
     # §gateway): an HTTP gateway over one engine replica, one streamed
@@ -809,6 +907,8 @@ ci_all() {
     multichip_dryrun
     bench_smoke
     serve_smoke
+    paged_kv_smoke
+    paged_kv_slow
     gateway_smoke
     fleet_smoke
     chaos_serve
@@ -830,6 +930,7 @@ ci_fast() {
     unittest_fast
     bench_smoke
     serve_smoke
+    paged_kv_smoke
     gateway_smoke
     fleet_smoke
     chaos_serve

@@ -36,7 +36,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import (flash_attention, dense_attention,
                              ring_attention, ulysses_attention,
-                             slot_decode_attention)
+                             slot_decode_attention,
+                             paged_decode_attention)
 from ..parallel.sharding import ShardingRules, constrain
 from ..parallel.sharding import mcon as _mcon
 
@@ -47,7 +48,9 @@ __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "quantize_params_int8", "int8_sharding_rules",
            "sample_logits", "init_slot_cache", "slot_cache_specs",
            "prefill_slot", "decode_slots", "prefill_detached",
-           "inject_slot_kv"]
+           "inject_slot_kv", "paged_cache_specs", "init_paged_cache",
+           "decode_slots_paged", "prefill_slot_paged",
+           "inject_paged_kv", "copy_page"]
 
 
 @dataclass(frozen=True)
@@ -1279,3 +1282,460 @@ def inject_slot_kv(cfg: LlamaConfig, k_block, v_block, true_len, slot,
             a, NamedSharding(mesh, specs[n]))
             for n, a in new_sv.items()}
     return new_kv, new_sv
+
+
+# ---------------------------------------------------------------------------
+# paged serving: fixed-size KV page pool + per-slot page tables
+# (PagedAttention, Kwon et al. SOSP '23). The dense slot bank above
+# reserves max_len KV per slot whether or not a request ever grows
+# there; the paged variant keeps ONE flat pool of (n_pages, kvh,
+# page_size, hd) pages per layer and maps each slot's logical sequence
+# through an int32 page-table row the host owns. Admission is bounded
+# by free PAGES, not slots, and read-only pages can be shared between
+# slots (refcounted copy-on-write prefix sharing — the allocator lives
+# in ``mxtpu.serve.engine``; these are its device halves). Page 0 is
+# scratch: the engine never hands it out, zeroed table rows alias it,
+# and redirected writes land there harmlessly.
+# ---------------------------------------------------------------------------
+
+def paged_cache_specs(cfg: LlamaConfig, mesh: Mesh):
+    """PartitionSpecs for the paged pool: kv heads over tp (axis 2 of
+    the (L, n_pages, kvh, page_size, hd) pool — same head-axis rule as
+    :func:`slot_cache_specs`), page axis unsharded (the host scatters
+    single pages). Scale pools (int8 mode) follow the same spec."""
+    tp = ("tp" if "tp" in mesh.axis_names
+          and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None)
+    kv = P(None, None, tp) if tp is not None else P()
+    return {"k": kv, "v": kv, "ks": kv, "vs": kv,
+            "lengths": P(), "tokens": P(), "rngs": P()}
+
+
+def init_paged_cache(cfg: LlamaConfig, max_slots: int, n_pages: int,
+                     page_size: int, mesh: Optional[Mesh] = None,
+                     int8: bool = False):
+    """Device state for the PAGED serving engine: per-layer K/V pools
+    of (L, n_pages, n_kv_heads, page_size, hd) plus the same per-slot
+    ``lengths``/``tokens``/``rngs`` vectors as :func:`init_slot_cache`
+    (page tables stay HOST-side — a small int32 operand per step, so
+    table edits never touch device state). ``int8=True`` stores the
+    pools as int8 with per-token-per-head f32 scales ``ks``/``vs`` of
+    (L, n_pages, kvh, page_size) — KV HBM halves again; dequant happens
+    on gather (deterministic, not bit-exact with the f32 pool —
+    docs/serving.md)."""
+    hd = cfg.head_dim
+    shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, hd)
+
+    def build():
+        if int8:
+            pools = {"k": jnp.zeros(shape, jnp.int8),
+                     "v": jnp.zeros(shape, jnp.int8),
+                     "ks": jnp.ones(shape[:4], jnp.float32),
+                     "vs": jnp.ones(shape[:4], jnp.float32)}
+        else:
+            pools = {"k": jnp.zeros(shape, cfg.dtype),
+                     "v": jnp.zeros(shape, cfg.dtype)}
+        pools.update({
+            "lengths": jnp.zeros((max_slots,), jnp.int32),
+            "tokens": jnp.zeros((max_slots,), jnp.int32),
+            "rngs": jnp.zeros((max_slots, 2), jnp.uint32)})
+        return pools
+
+    if mesh is None:
+        return build()
+    from jax.sharding import NamedSharding
+    specs = paged_cache_specs(cfg, mesh)
+    shardings = {n: NamedSharding(mesh, specs[n]) for n in build()}
+    return jax.jit(build, out_shardings=shardings)()
+
+
+def _q8_token(x):
+    """Per-token-per-head symmetric int8: scale over the hd axis."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _gather_slot_pages(pool, scales, pages_row, dt):
+    """One slot's pages → a contiguous (L, kvh, cap, hd) cache view.
+    pool: (L, n_pages, kvh, ps, hd); pages_row: (P,) int32."""
+    g = jnp.take(pool, pages_row, axis=1)        # (L, P, kvh, ps, hd)
+    if scales is not None:
+        sc = jnp.take(scales, pages_row, axis=1)  # (L, P, kvh, ps)
+        g = g.astype(jnp.float32) * sc[..., None]
+    L, Pn, hkv, ps, hd = g.shape
+    return (g.transpose(0, 2, 1, 3, 4)
+             .reshape(L, hkv, Pn * ps, hd).astype(dt))
+
+
+def _layer_slots_paged(cfg: LlamaConfig, cos, sin, pos, phys, off,
+                       page_table, mesh, kvspec, x, lp, ck, cv,
+                       cks=None, cvs=None):
+    """One block of the PAGED slot decode: x (S, 1, dim); ck/cv are the
+    per-layer page POOLS (n_pages, kvh, ps, hd). Each slot's new K/V
+    scatters into pool page ``phys[i]`` at in-page offset ``off[i]``
+    (the host redirects inactive slots to scratch page 0 — their table
+    rows are zeroed, so no live page can alias the write), then the
+    slot attends its gathered pages via the length-masked paged
+    kernel."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ _wq8(lp["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ _wq8(lp["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ _wq8(lp["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = q.transpose(0, 2, 1, 3)          # (S, h, 1, hd)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    head_ax = (kvspec[1] if kvspec is not None and len(kvspec) > 1
+               else None)
+    q = _mcon(mesh, q, None, head_ax, None, None)
+    k = _mcon(mesh, k, None, head_ax, None, None)
+    v = _mcon(mesh, v, None, head_ax, None, None)
+
+    knew = k[:, :, 0, :]                 # (S, kvh, hd)
+    vnew = v[:, :, 0, :]
+    if cks is not None:                  # int8 pool: quantize the write
+        kq, ksc = _q8_token(knew)
+        vq, vsc = _q8_token(vnew)
+        ck = ck.at[phys, :, off, :].set(kq)
+        cv = cv.at[phys, :, off, :].set(vq)
+        cks = cks.at[phys, :, off].set(ksc)
+        cvs = cvs.at[phys, :, off].set(vsc)
+        kf = _gather_slot_pages_batch(ck, cks, page_table, dt)
+        vf = _gather_slot_pages_batch(cv, cvs, page_table, dt)
+        o = slot_decode_attention(q, kf, vf, pos + 1)
+    else:
+        ck = ck.at[phys, :, off, :].set(knew.astype(ck.dtype))
+        cv = cv.at[phys, :, off, :].set(vnew.astype(cv.dtype))
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            ck = lax.with_sharding_constraint(
+                ck, NamedSharding(mesh, kvspec))
+            cv = lax.with_sharding_constraint(
+                cv, NamedSharding(mesh, kvspec))
+        o = paged_decode_attention(q, ck, cv, page_table, pos + 1)
+
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    x = x + _mcon(mesh, o @ _wq8(lp["wo"], dt), None, None, None)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    delta, _ = _ffn(cfg, lp, h, mesh, serving=True)
+    x = x + _mcon(mesh, delta, None, None, None)
+    if cks is not None:
+        return x, ck, cv, cks, cvs
+    return x, ck, cv
+
+
+def _gather_slot_pages_batch(pool, scales, page_table, dt):
+    """All slots' pages → (S, kvh, cap, hd) with int8 dequant on the
+    gathered bytes (the whole-pool dequant would undo the HBM win)."""
+    # pool here is PER-LAYER: (n_pages, kvh, ps, hd); page_table is
+    # (S, P) so the take yields (S, P, kvh, ps, hd)
+    g = jnp.take(pool, page_table, axis=0)
+    sc = jnp.take(scales, page_table, axis=0)     # (S, P, kvh, ps)
+    g = g.astype(jnp.float32) * sc[..., None]
+    S, Pn, hkv, ps, hd = g.shape
+    return (g.transpose(0, 2, 1, 3, 4)
+             .reshape(S, hkv, Pn * ps, hd).astype(dt))
+
+
+def decode_slots_paged(cfg: LlamaConfig, params, kv, sv, active,
+                       page_table, temperature, top_k, top_p,
+                       mesh: Optional[Mesh] = None):
+    """ONE decode step over the PAGED bank — :func:`decode_slots` with
+    the dense (slot, max_len) cache row replaced by a page-table
+    indirection. ``page_table`` (S, pages_per_slot) int32 is a small
+    per-step operand (host-owned: admission edits tables without
+    touching device state, and the jit cache key never changes).
+    Inactive slots carry zeroed table rows, so their cache write lands
+    in scratch page 0 and their (discarded) sample reads scratch —
+    active slots' pages are never aliased. Sampling, rng chains, and
+    the length mask are IDENTICAL to the dense path, which is what
+    keeps paged serving bit-identical to per-request ``generate``
+    (asserted in tests/test_paged_kv.py). kv: the pool dict from
+    :func:`init_paged_cache` minus the per-slot vectors (donatable);
+    sv as in :func:`decode_slots`."""
+    int8 = "ks" in kv
+    ps = kv["k"].shape[3]
+    cap = page_table.shape[1] * ps
+    lengths = sv["lengths"].astype(jnp.int32)
+    pos = jnp.minimum(lengths, cap - 1)       # per-slot write position
+    nslots = page_table.shape[0]
+    phys = page_table[jnp.arange(nslots), pos // ps]  # (S,) pool index
+    off = pos % ps
+    tokens = sv["tokens"][:, None]
+    emb = params["tok_embed"]
+    if isinstance(emb, dict):
+        x = emb["q8"][tokens].astype(cfg.dtype) * \
+            emb["s8"][0].astype(cfg.dtype)
+    else:
+        x = emb[tokens].astype(cfg.dtype)
+
+    kvspec = None
+    if mesh is not None:
+        kvspec = P(*tuple(paged_cache_specs(cfg, mesh)["k"])[1:])
+    cos_t, sin_t = rope_tables(cfg, cap)
+    cos = cos_t[pos][:, None, None, :]        # (S, 1, 1, hd/2)
+    sin = sin_t[pos][:, None, None, :]
+
+    if int8:
+        def body(x, xs):
+            lp, ck, cv, cks, cvs = xs
+            x, ck, cv, cks, cvs = _layer_slots_paged(
+                cfg, cos, sin, pos, phys, off, page_table, mesh,
+                kvspec, x, lp, ck, cv, cks, cvs)
+            return x, (ck, cv, cks, cvs)
+        x, (ck, cv, cks, cvs) = lax.scan(
+            body, x, (params["layers"], kv["k"], kv["v"],
+                      kv["ks"], kv["vs"]))
+        new_kv = {"k": ck, "v": cv, "ks": cks, "vs": cvs}
+    else:
+        def body(x, xs):
+            lp, ck, cv = xs
+            x, ck, cv = _layer_slots_paged(
+                cfg, cos, sin, pos, phys, off, page_table, mesh,
+                kvspec, x, lp, ck, cv)
+            return x, (ck, cv)
+        x, (ck, cv) = lax.scan(body, x,
+                               (params["layers"], kv["k"], kv["v"]))
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            full = NamedSharding(mesh, paged_cache_specs(cfg, mesh)["k"])
+            ck = lax.with_sharding_constraint(ck, full)
+            cv = lax.with_sharding_constraint(cv, full)
+        new_kv = {"k": ck, "v": cv}
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    hw = (_wq8(params["tok_embed"], cfg.dtype).T if cfg.tie_embeddings
+          else _wq8(params["lm_head"], cfg.dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, hw,
+                        preferred_element_type=jnp.float32)[:, 0]
+
+    def one(key, lg, t, kk, pp):
+        key, sub = jax.random.split(key)
+        tok = sample_logits(sub, lg[None], temperature=t,
+                            top_k=kk, top_p=pp)[0]
+        return key, tok
+
+    new_rngs, sampled = jax.vmap(one)(
+        sv["rngs"], logits, temperature, top_k, top_p)
+    new_lengths = lengths + active.astype(jnp.int32)
+    if mesh is not None:
+        sampled = _mcon(mesh, sampled, None)
+        new_lengths = _mcon(mesh, new_lengths, None)
+        new_rngs = _mcon(mesh, new_rngs, None, None)
+    return sampled, new_kv, \
+        {"lengths": new_lengths, "tokens": sampled, "rngs": new_rngs}
+
+
+def _scatter_slot_pages(kv, pages_row, tmp_k, tmp_v, prefix_len,
+                        bucket, int8):
+    """Write a slot's contiguous (L, 1, kvh, cap, hd) cache view back
+    into the pools at its pages. In f32/bf16 mode the WHOLE view is
+    scattered — shared prefix pages are rewritten with bit-identical
+    content (the gather/forward never modified them) and duplicate
+    scratch indices in ``pages_row`` collapse onto page 0, which is
+    never attended. In int8 mode only the freshly written span
+    [prefix_len, prefix_len+bucket) is re-quantized; untouched
+    positions keep their RAW stored bytes — quantize∘dequant is not
+    idempotent, so round-tripping shared pages would corrupt them."""
+    L, _, hkv, cap, hd = tmp_k.shape
+    ps = kv["k"].shape[3]
+    Pn = pages_row.shape[0]
+
+    def to_pages(a):                      # (L, kvh, cap, hd) → pages
+        return (a.reshape(L, hkv, Pn, ps, hd)
+                 .transpose(0, 2, 1, 3, 4))
+
+    kd, vd = tmp_k[:, 0], tmp_v[:, 0]     # (L, kvh, cap, hd)
+    out = dict(kv)
+    if int8:
+        kq, ksc = _q8_token(kd)           # (L, kvh, cap, hd)/(L,kvh,cap)
+        vq, vsc = _q8_token(vd)
+        written = ((jnp.arange(cap) >= prefix_len) &
+                   (jnp.arange(cap) < prefix_len + bucket))
+        old_k = _gather_pages_raw(kv["k"], pages_row)   # (L, kvh, cap, hd)
+        old_v = _gather_pages_raw(kv["v"], pages_row)
+        old_ks = _gather_pages_raw(kv["ks"], pages_row)
+        old_vs = _gather_pages_raw(kv["vs"], pages_row)
+        kq = jnp.where(written[None, None, :, None], kq, old_k)
+        vq = jnp.where(written[None, None, :, None], vq, old_v)
+        ksc = jnp.where(written[None, None, :], ksc, old_ks)
+        vsc = jnp.where(written[None, None, :], vsc, old_vs)
+        out["k"] = kv["k"].at[:, pages_row].set(to_pages(kq))
+        out["v"] = kv["v"].at[:, pages_row].set(to_pages(vq))
+        sc_pages = lambda a: (a.reshape(L, hkv, Pn, ps)
+                               .transpose(0, 2, 1, 3))
+        out["ks"] = kv["ks"].at[:, pages_row].set(sc_pages(ksc))
+        out["vs"] = kv["vs"].at[:, pages_row].set(sc_pages(vsc))
+    else:
+        out["k"] = kv["k"].at[:, pages_row].set(
+            to_pages(kd.astype(kv["k"].dtype)))
+        out["v"] = kv["v"].at[:, pages_row].set(
+            to_pages(vd.astype(kv["v"].dtype)))
+    return out
+
+
+def _gather_pages_raw(pool, pages_row):
+    """(L, n_pages, kvh, ps[, hd]) pool → contiguous (L, kvh, cap[,
+    hd]) view of one slot's pages, NO dequant (raw stored bytes)."""
+    g = jnp.take(pool, pages_row, axis=1)
+    if g.ndim == 5:
+        L, Pn, hkv, ps, hd = g.shape
+        return g.transpose(0, 2, 1, 3, 4).reshape(L, hkv, Pn * ps, hd)
+    L, Pn, hkv, ps = g.shape
+    return g.transpose(0, 2, 1, 3).reshape(L, hkv, Pn * ps)
+
+
+def prefill_slot_paged(cfg: LlamaConfig, params, tokens, true_len,
+                       prefix_len, pages_row, slot, kv, sv, rng,
+                       temperature, top_k, top_p,
+                       mesh: Optional[Mesh] = None):
+    """Paged admission, cold OR warm: gather the slot's pages into a
+    contiguous cache view, run the SUFFIX tokens (END-padded to their
+    bucket) through the cached stack at ``pos=prefix_len``, scatter the
+    pages back, seed the slot vectors, and sample the first generated
+    token.
+
+    Warm admission (``prefix_len > 0``) is what prefix sharing buys:
+    the shared pages already hold positions [0, prefix_len), the
+    suffix attends them through the causal mask exactly as
+    ``chunked_prefill`` attends an earlier chunk (the established
+    bit-identity property), and only ``len(prompt) - prefix_len``
+    tokens pay forward FLOPs — the TTFT win. Cold admission is the
+    same program at ``prefix_len=0``. One compiled program per SUFFIX
+    bucket (the same power-of-two set as dense prefill, so the
+    compile bound is unchanged).
+
+    tokens: (1, bucket) suffix; true_len: TOTAL valid length
+    (prefix + real suffix); pages_row: (pages_per_slot,) int32 — the
+    slot's full table row (scratch-0 tail entries collapse onto the
+    never-attended page 0). The engine guarantees write range
+    [prefix_len, prefix_len+bucket) stays inside the row's capacity
+    and that every page it touches is PRIVATE (CoW forked). Returns
+    (first token (1,), new kv pools, new sv)."""
+    b, bucket = tokens.shape
+    int8 = "ks" in kv
+    dt = cfg.dtype
+    true_len = jnp.asarray(true_len, jnp.int32)
+    prefix_len = jnp.asarray(prefix_len, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    tmp = {"k": _gather_slot_pages(kv["k"], kv.get("ks"), pages_row,
+                                   dt)[:, None],
+           "v": _gather_slot_pages(kv["v"], kv.get("vs"), pages_row,
+                                   dt)[:, None],
+           "pos": prefix_len}
+    logits, tmp = _forward_cached(cfg, params, tokens, tmp, mesh=mesh,
+                                  last_index=true_len - prefix_len - 1)
+    rng, sub = jax.random.split(rng)
+    tok = sample_logits(sub, logits[:, 0], temperature=temperature,
+                        top_k=top_k, top_p=top_p)
+    new_kv = _scatter_slot_pages(kv, pages_row, tmp["k"], tmp["v"],
+                                 prefix_len, bucket, int8)
+    z = jnp.zeros((), jnp.int32)
+    new_sv = {
+        "lengths": lax.dynamic_update_slice(
+            sv["lengths"].astype(jnp.int32), true_len[None], (slot,)),
+        "tokens": lax.dynamic_update_slice(
+            sv["tokens"], tok.astype(sv["tokens"].dtype), (slot,)),
+        "rngs": lax.dynamic_update_slice(
+            sv["rngs"], rng[None].astype(sv["rngs"].dtype), (slot, z)),
+    }
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        specs = paged_cache_specs(cfg, mesh)
+        new_kv = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n]))
+            for n, a in new_kv.items()}
+        new_sv = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n]))
+            for n, a in new_sv.items()}
+        tok = _mcon(mesh, tok, None)
+    return tok, new_kv, new_sv
+
+
+def inject_paged_kv(cfg: LlamaConfig, k_block, v_block, true_len,
+                    pages_row, slot, token, rng, kv, sv,
+                    mesh: Optional[Mesh] = None):
+    """Decode-side admission of a handed-off prefill into the PAGED
+    bank: split the (L, n_kv_heads, bucket, hd) block into page_size
+    chunks and scatter them at the slot's first ceil(bucket/ps) pages —
+    :func:`inject_slot_kv`'s role for the paged layout. Pad K/V beyond
+    ``true_len`` land in pages the slot owns and are excluded by its
+    length mask (same argument as the dense path). In int8 mode the
+    block is quantized per token on the way in. kv donatable. Returns
+    (new kv pools, new sv)."""
+    int8 = "ks" in kv
+    ps = kv["k"].shape[3]
+    L, hkv, bucket, hd = k_block.shape
+    n_blk = -(-bucket // ps)              # pages the block spans
+    pad = n_blk * ps - bucket
+    if pad:
+        k_block = jnp.pad(k_block, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v_block = jnp.pad(v_block, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    true_len = jnp.asarray(true_len, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    token = jnp.asarray(token, jnp.int32)
+    dst = pages_row[:n_blk]
+
+    def to_pages(a):                      # (L, kvh, nP·ps, hd) → pages
+        return (a.reshape(L, hkv, n_blk, ps, hd)
+                 .transpose(0, 2, 1, 3, 4))
+
+    out = dict(kv)
+    if int8:
+        kq, ksc = _q8_token(k_block)
+        vq, vsc = _q8_token(v_block)
+        out["k"] = kv["k"].at[:, dst].set(to_pages(kq))
+        out["v"] = kv["v"].at[:, dst].set(to_pages(vq))
+        sc_pages = lambda a: (a.reshape(L, hkv, n_blk, ps)
+                               .transpose(0, 2, 1, 3))
+        out["ks"] = kv["ks"].at[:, dst].set(sc_pages(ksc))
+        out["vs"] = kv["vs"].at[:, dst].set(sc_pages(vsc))
+    else:
+        out["k"] = kv["k"].at[:, dst].set(
+            to_pages(k_block.astype(kv["k"].dtype)))
+        out["v"] = kv["v"].at[:, dst].set(
+            to_pages(v_block.astype(kv["v"].dtype)))
+    z = jnp.zeros((), jnp.int32)
+    new_sv = {
+        "lengths": lax.dynamic_update_slice(
+            sv["lengths"].astype(jnp.int32), true_len[None], (slot,)),
+        "tokens": lax.dynamic_update_slice(
+            sv["tokens"], token[None].astype(sv["tokens"].dtype),
+            (slot,)),
+        "rngs": lax.dynamic_update_slice(
+            sv["rngs"], rng[None].astype(sv["rngs"].dtype), (slot, z)),
+    }
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        specs = paged_cache_specs(cfg, mesh)
+        out = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n])) for n, a in out.items()}
+        new_sv = {n: lax.with_sharding_constraint(
+            a, NamedSharding(mesh, specs[n]))
+            for n, a in new_sv.items()}
+    return out, new_sv
+
+
+def copy_page(kv, src, dst):
+    """Copy pool page ``src`` onto page ``dst`` across every pool array
+    — the engine's copy-on-write fork primitive (one compiled program
+    for any src/dst: both are traced scalars). Only pool arrays (page
+    axis 1) are touched; per-slot vectors pass through untouched."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    out = dict(kv)
+    for n in ("k", "v", "ks", "vs"):
+        if n in kv:
+            a = kv[n]
+            page = lax.dynamic_index_in_dim(a, src, axis=1,
+                                            keepdims=False)
+            out[n] = lax.dynamic_update_index_in_dim(a, page, dst,
+                                                     axis=1)
+    return out

@@ -24,7 +24,8 @@ from jax import lax
 
 __all__ = ["dense_attention", "blockwise_attention", "flash_attention",
            "ulysses_attention",
-           "ring_attention", "slot_decode_attention"]
+           "ring_attention", "slot_decode_attention",
+           "paged_decode_attention"]
 
 _NEG_INF = -1e30  # finite "minus infinity": keeps fully-masked rows NaN-free
 
@@ -257,6 +258,42 @@ def slot_decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
                             (jnp.arange(nblk), kb, vb))
     out = _finalize(m, l, o, q.dtype)
     return out.reshape(b, hq, sq, d)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           scale: Optional[float] = None,
+                           kv_block: int = 512):
+    """Decode attention over a PAGED KV pool (vLLM's PagedAttention,
+    Kwon et al. SOSP '23): the cache is a flat pool of fixed-size pages
+    and each slot's logical KV sequence is the concatenation of the
+    pool pages its row of ``page_table`` names. Gather + the blockwise
+    ``slot_decode_attention`` online softmax — bit-exact with the dense
+    slot kernel on the same logical KV (the gather materializes the
+    identical (slots, kvh, capacity, hd) operand; trailing pages past
+    ``lengths`` are fully masked, which the online-softmax scan treats
+    as an exact no-op: m unchanged, corr = exp(0) = 1, p zeroed).
+
+    q: (slots, n_heads, s, hd) — s is 1 in decode.
+    k_pages, v_pages: (n_pages, n_kv_heads, page_size, hd) — the shared
+    pool. Page 0 is the engine's scratch page (never attended: every
+    real table entry covering positions < lengths names a live page).
+    page_table: (slots, pages_per_slot) int32 — slot i's logical page j
+    lives at pool index ``page_table[i, j]``.
+    lengths: (slots,) int — slot i attends positions ``[0, lengths[i])``
+    of its gathered sequence.
+    """
+    if q.shape[0] != page_table.shape[0]:
+        raise ValueError(
+            f"page_table rows {page_table.shape[0]} != slots {q.shape[0]}")
+    n_pages, hkv, page_size, d = k_pages.shape
+    slots, per_slot = page_table.shape
+    # gather (S, P, kvh, ps, hd) → contiguous (S, kvh, P*ps, hd)
+    def flat(pool):
+        g = jnp.take(pool, page_table, axis=0)
+        return (g.transpose(0, 2, 1, 3, 4)
+                 .reshape(slots, hkv, per_slot * page_size, d))
+    return slot_decode_attention(q, flat(k_pages), flat(v_pages), lengths,
+                                 scale=scale, kv_block=kv_block)
 
 
 def ring_attention(q, k, v, *, axis_name: str = "sp",

@@ -54,7 +54,7 @@ from ..telemetry import distributed as dtrace
 from ..models import llama
 
 __all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for",
-           "resume_key"]
+           "resume_key", "PageAllocator", "PrefixCache"]
 
 # admission wait is measured in engine steps (arrival → slot grant)
 _WAIT_STEP_BUCKETS = (0.0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 1024)
@@ -102,6 +102,28 @@ def _engine_metrics(eid: str):
         "kv_occ": telemetry.gauge(
             "serve_kv_occupancy_ratio",
             "live/reserved fraction of the KV slot bank", engine=eid),
+        # paged mode: the page pool the dense gauges above argue for
+        "pages_total": telemetry.gauge(
+            "serve_kv_pages_total",
+            "Allocatable pages in the paged KV pool (scratch page 0 "
+            "excluded)", engine=eid),
+        "pages_free": telemetry.gauge(
+            "serve_kv_pages_free",
+            "Pages not mapped by any slot or prefix-cache entry",
+            engine=eid),
+        "pages_shared": telemetry.gauge(
+            "serve_kv_pages_shared",
+            "Pages mapped by more than one owner (refcount >= 2)",
+            engine=eid),
+        "prefix_hits": telemetry.counter(
+            "serve_prefix_cache_hits_total",
+            "Admissions seated on shared prefix pages (warm prefill)"),
+        "prefix_misses": telemetry.counter(
+            "serve_prefix_cache_misses_total",
+            "Admissions that found no usable shared prefix"),
+        "cow": telemetry.counter(
+            "serve_cow_forks_total",
+            "Copy-on-write page forks (private copy of a shared page)"),
     }
 
 
@@ -123,6 +145,161 @@ def bucket_for(length: int, min_bucket: int, max_len: int) -> int:
     while b < length:
         b *= 2
     return min(b, max_len)
+
+
+class PageAllocator:
+    """Host-side refcounted allocator over the paged KV pool (the
+    scheduler half of PagedAttention): pages are handed out from a free
+    stack, shared read-only via :meth:`retain` (prefix sharing), and
+    returned to the stack only when their last owner releases them.
+    Page 0 is the SCRATCH page — never allocated, zeroed page-table
+    rows alias it, redirected writes land there. Pure host state; the
+    caller (ServeEngine) serializes access under its own lock."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (scratch + 1), got {n_pages}")
+        self.n_pages = int(n_pages)
+        self._ref = np.zeros(self.n_pages, np.int32)
+        # LIFO free stack: recently-freed pages are re-handed first
+        # (their HBM is warm); page 0 is never a member
+        self._free = list(range(self.n_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.n_pages - 1) - len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages with more than one owner (slot rows + cache entries)."""
+        return int((self._ref >= 2).sum())
+
+    def refcount(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages (refcount 1 each), or None — NEVER a
+        partial grant: admission must be all-or-nothing so a request
+        that cannot fully seat leaves the pool untouched."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._ref[p] = 1
+        return out
+
+    def retain(self, pages) -> None:
+        """Add an owner to already-live pages (prefix sharing)."""
+        for p in pages:
+            if p == 0 or self._ref[p] < 1:
+                raise ValueError(f"retain of non-live page {p}")
+        for p in pages:
+            self._ref[p] += 1
+
+    def release(self, pages) -> None:
+        """Drop one ownership per page; a page's last release frees it."""
+        for p in pages:
+            if p == 0 or self._ref[p] < 1:
+                raise ValueError(f"release of non-live page {p}")
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(int(p))
+
+
+@dataclass
+class _PrefixEntry:
+    tokens: Tuple[int, ...]     # the full registered prompt
+    n_tokens: int               # positions the pages actually cover
+    pages: Tuple[int, ...]      # cache-owned (retained) pages
+    hits: int = 0
+    last_used: int = 0
+
+
+class PrefixCache:
+    """LRU map of registered prompt prefixes → the pool pages holding
+    their KV (RadixAttention's sharing, flat-keyed: a handful of system
+    prompts dominate real traffic, so a bounded linear scan beats a
+    radix tree at this scale). Entries OWN a refcount on their pages,
+    so a prefix outlives the request that prefilled it; eviction (LRU,
+    or on-demand when admission runs dry) releases that hold — pages
+    still mapped by live slots survive via the slots' own refs."""
+
+    def __init__(self, allocator: PageAllocator, max_entries: int = 32):
+        self._alloc = allocator
+        self.max_entries = int(max_entries)
+        self._entries: Dict[Tuple[int, ...], _PrefixEntry] = {}
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, prompt) -> Tuple[Optional[_PrefixEntry], int]:
+        """Longest registered prefix of ``prompt``, capped at
+        ``len(prompt) - 1`` — the last prompt token ALWAYS runs through
+        the forward pass (its logits seed the first sample)."""
+        pl = len(prompt)
+        pt = tuple(int(x) for x in prompt)
+        best, best_m = None, 0
+        for e in self._entries.values():
+            cap = min(e.n_tokens, pl - 1)
+            if cap <= best_m:
+                continue
+            m = 0
+            while m < cap and pt[m] == e.tokens[m]:
+                m += 1
+            if m > best_m:
+                best, best_m = e, m
+        return best, best_m
+
+    def touch(self, entry: _PrefixEntry) -> None:
+        self._tick += 1
+        entry.last_used = self._tick
+        entry.hits += 1
+
+    def insert(self, tokens, n_tokens: int, pages) -> _PrefixEntry:
+        """Register ``pages`` as covering ``tokens[:n_tokens]``. The
+        pages must already be live; the cache retains its own hold on
+        them. Over-capacity inserts evict LRU first."""
+        key = tuple(int(x) for x in tokens)
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._alloc.release(old.pages)
+        while len(self._entries) >= self.max_entries:
+            if not self.evict_lru():
+                break
+        self._alloc.retain(pages)
+        self._tick += 1
+        e = _PrefixEntry(key, int(n_tokens),
+                         tuple(int(p) for p in pages),
+                         last_used=self._tick)
+        self._entries[key] = e
+        return e
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used entry, releasing its page hold.
+        Returns False when the cache is empty."""
+        if not self._entries:
+            return False
+        key = min(self._entries,
+                  key=lambda k: self._entries[k].last_used)
+        e = self._entries.pop(key)
+        self._alloc.release(e.pages)
+        return True
+
+    def top(self, n: int = 5) -> List[Dict[str, Any]]:
+        """The most-hit prefixes — diagnose/Grafana fodder."""
+        es = sorted(self._entries.values(), key=lambda e: -e.hits)[:n]
+        return [{"n_tokens": e.n_tokens, "hits": e.hits,
+                 "pages": len(e.pages),
+                 "head": list(e.tokens[:8])} for e in es]
 
 
 @dataclass
@@ -236,7 +413,12 @@ class ServeEngine:
                  max_len: Optional[int] = None,
                  min_bucket: Optional[int] = None,
                  mesh=None, overlap: Optional[bool] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 paged: bool = False,
+                 page_size: Optional[int] = None,
+                 n_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 int8_pages: Optional[bool] = None):
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
@@ -260,9 +442,44 @@ class ServeEngine:
         # served each segment, not just which replica
         self.build: Optional[str] = None
 
-        state = llama.init_slot_cache(cfg, self.max_slots,
-                                      self.max_len, mesh=mesh)
-        self._kv = {"k": state["k"], "v": state["v"]}
+        # paged mode (PagedAttention): KV lives in a fixed page pool
+        # with host-owned per-slot page tables; admission is bounded by
+        # free PAGES, not slots, and prefix pages are shared CoW
+        self.paged = bool(paged)
+        if self.paged:
+            self.page_size = int(page_size
+                                 or _env_int("MXTPU_KV_PAGE_SIZE", 16))
+            self._pages_per_slot = -(-self.max_len // self.page_size)
+            # default pool = dense-equivalent capacity + scratch: the
+            # A/B bench shrinks it to show paged admits more slots at
+            # the same HBM
+            self.n_pages = int(n_pages or _env_int(
+                "MXTPU_KV_PAGES",
+                self.max_slots * self._pages_per_slot + 1))
+            self.prefix_cache_enabled = (
+                prefix_cache if prefix_cache is not None
+                else os.environ.get("MXTPU_KV_PREFIX_CACHE", "1")
+                != "0")
+            self.int8_pages = (
+                bool(int8_pages) if int8_pages is not None
+                else os.environ.get("MXTPU_KV_INT8_PAGES", "0") == "1")
+        else:
+            self.page_size = None
+            self.n_pages = 0
+            self.prefix_cache_enabled = False
+            self.int8_pages = False
+
+        if self.paged:
+            state = llama.init_paged_cache(
+                cfg, self.max_slots, self.n_pages, self.page_size,
+                mesh=mesh, int8=self.int8_pages)
+            pool_keys = (("k", "v", "ks", "vs") if self.int8_pages
+                         else ("k", "v"))
+            self._kv = {n: state[n] for n in pool_keys}
+        else:
+            state = llama.init_slot_cache(cfg, self.max_slots,
+                                          self.max_len, mesh=mesh)
+            self._kv = {"k": state["k"], "v": state["v"]}
         self._sv = {n: state[n] for n in ("lengths", "tokens", "rngs")}
         # the kv bank is donated through every program (in-place in
         # HBM); the small vectors are not, so the previous step's
@@ -271,11 +488,34 @@ class ServeEngine:
         # spurious-recompile anomaly (recompile_total + offending key)
         telemetry.install_compile_listener()
         self._decode = telemetry.watch(
-            jax.jit(partial(llama.decode_slots, cfg, mesh=mesh),
+            jax.jit(partial(llama.decode_slots_paged if self.paged
+                            else llama.decode_slots, cfg, mesh=mesh),
                     donate_argnums=(1,)),
             "serve_decode", expected=1, loop="serve")
         self._prefills: Dict[int, Any] = {}
         self._injects: Dict[int, Any] = {}
+        if self.paged:
+            # host page-table (a small int32 operand per step), the
+            # refcounted allocator, the prefix cache, and the CoW
+            # fork program (ONE program: src/dst are traced scalars)
+            self._pt = np.zeros(
+                (self.max_slots, self._pages_per_slot), np.int32)
+            self._pages = PageAllocator(self.n_pages)
+            self._prefix = (PrefixCache(self._pages)
+                            if self.prefix_cache_enabled else None)
+            # a per-engine wrapper (NOT bare llama.copy_page): jit
+            # caches key on callable identity, so a shared function
+            # would alias cache sizes across engines and skew both the
+            # recompile watcher and compile_count's churn gate
+            self._copy_fn = telemetry.watch(
+                jax.jit(lambda kv, src, dst: llama.copy_page(
+                    kv, src, dst), donate_argnums=(0,)),
+                "serve_copy_page", expected=1)
+            # engine-local tallies (the telemetry counters are
+            # process-wide totals shared across engines)
+            self._prefix_hits = 0
+            self._prefix_misses = 0
+            self._cow_forks = 0
         eid = str(next(_engine_seq))
         self.engine_id = eid
         self._m = _engine_metrics(eid)
@@ -305,18 +545,32 @@ class ServeEngine:
         # would block the decode loop every token, MXL004). Reserved
         # bytes count the bank's global logical size across the mesh.
         self._slot_len = np.zeros(S, np.int64)
-        itemsize = np.dtype(state["k"].dtype).itemsize
-        self._kv_tok_bytes = (2 * cfg.n_layers * cfg.n_kv_heads
-                              * cfg.head_dim * itemsize)
-        self._kv_reserved = int(state["k"].nbytes + state["v"].nbytes)
+        if self.paged:
+            # per-token bytes include the scale planes in int8 mode;
+            # reserved counts the whole pool (scratch page included —
+            # it is real HBM)
+            self._kv_reserved = int(sum(a.nbytes
+                                        for a in self._kv.values()))
+            self._kv_tok_bytes = (self._kv_reserved
+                                  // (self.n_pages * self.page_size))
+            self._m["pages_total"].set(self.n_pages - 1)
+            self._m["pages_free"].set(self._pages.free_pages)
+            self._m["pages_shared"].set(0)
+        else:
+            itemsize = np.dtype(state["k"].dtype).itemsize
+            self._kv_tok_bytes = (2 * cfg.n_layers * cfg.n_kv_heads
+                                  * cfg.head_dim * itemsize)
+            self._kv_reserved = int(state["k"].nbytes
+                                    + state["v"].nbytes)
         self._m["kv_reserved"].set(self._kv_reserved)
         self._m["kv_live"].set(0)
         self._m["kv_occ"].set(0.0)
         from ..telemetry import perfscope
         perfscope.ledger().account_tree("params", params,
                                         name=f"engine{eid}")
-        perfscope.ledger().account("kv_slot_bank", self._kv_reserved,
-                                   name=f"engine{eid}")
+        perfscope.ledger().account(
+            "kv_page_pool" if self.paged else "kv_slot_bank",
+            self._kv_reserved, name=f"engine{eid}")
 
         # batch mode (run()) returns the per-request token lists, so
         # it must retain them; a long-lived gateway replica must NOT —
@@ -382,6 +636,21 @@ class ServeEngine:
             raise ValueError(
                 f"handoff bucket {handoff.k.shape[2]} exceeds max_len "
                 f"{self.max_len}")
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        if prompt.size > handoff.true_len:
+            # journaled-page resume (paged mode): prompt = original +
+            # already-emitted tokens; admission injects the journaled
+            # pages and warm-prefills ONLY the emitted suffix — no
+            # prefill-worker round trip, same rng chain (resume_key)
+            if not self.paged:
+                raise ValueError(
+                    "handoff shorter than prompt: page-journaled "
+                    "resume requires a paged engine")
+            if prompt.size + request.max_new_tokens > self.max_len:
+                raise ValueError(
+                    f"prompt ({prompt.size}) + max_new_tokens "
+                    f"({request.max_new_tokens}) exceeds max_len "
+                    f"{self.max_len}")
         return self._enqueue(request, handoff=handoff)
 
     def _enqueue(self, request: Request,
@@ -485,9 +754,10 @@ class ServeEngine:
     # through it would stall every submitter and the gateway's
     # routing/scrape paths behind one admission.
     def _pick_admissions(self) -> List[Tuple[int, int, Request,
-                                             Optional[KVHandoff]]]:
+                                             Optional[KVHandoff],
+                                             Optional[Dict]]]:
         picks: List[Tuple[int, int, Request,
-                          Optional[KVHandoff]]] = []
+                          Optional[KVHandoff], Optional[Dict]]] = []
         while self._queue:
             arrival, rid, req = self._queue[0]
             if rid in self._ended:         # cancelled while queued
@@ -500,10 +770,24 @@ class ServeEngine:
             free = np.flatnonzero(~self._active)
             if free.size == 0:
                 break
+            plan = None
+            if self.paged:
+                # paged admission is bounded by free PAGES: plan the
+                # slot's table row (shared prefix + CoW fork + fresh
+                # pages) before committing; a pool too full to seat
+                # the head request leaves it QUEUED (backpressure,
+                # never a crash) — completions free pages and retry
+                plan = self._plan_pages(req, self._handoffs.get(rid))
+                if plan is None:
+                    break
             heapq.heappop(self._queue)
             slot = int(free[0])
             self._m["wait"].observe(max(0, self._step_idx - arrival))
             self._seat(slot, rid, req)
+            if plan is not None:
+                self._pt[slot, :] = 0
+                row = plan["row"]
+                self._pt[slot, :len(row)] = row
             if req.ctx is not None:
                 # once per admission, not per token: the timeline's
                 # "which bank, which slot, when" anchor for this hop
@@ -511,18 +795,124 @@ class ServeEngine:
                     telemetry.instant("serve.seat", slot=slot,
                                       role=self.role)
             picks.append((slot, rid, req,
-                          self._handoffs.pop(rid, None)))
+                          self._handoffs.pop(rid, None), plan))
         self._m["queue"].set(len(self._queue))
         self._m["slots"].set(int(self._active.sum()))
+        if self.paged:
+            self._m["pages_free"].set(self._pages.free_pages)
+            self._m["pages_shared"].set(self._pages.shared_pages)
         return picks
+
+    # -- paged admission planning (lock held) --------------------------------
+    def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing page grant; when the pool runs dry, evict
+        prefix-cache entries LRU-first (their pages come back the
+        moment no live slot shares them) and retry."""
+        while True:
+            pages = self._pages.alloc(n)
+            if pages is not None:
+                return pages
+            if self._prefix is None or not self._prefix.evict_lru():
+                return None
+
+    def _plan_pages(self, req: Request,
+                    handoff: Optional[KVHandoff]) -> Optional[Dict]:
+        """Plan one paged admission: how many pages, which are shared
+        from the prefix cache, where the CoW fork goes, and what gets
+        registered after prefill. Returns None on page exhaustion
+        (request stays queued). Mutates ONLY the allocator/prefix
+        cache (under the engine lock); the device work happens later
+        in ``_run_admissions``."""
+        ps = self.page_size
+        cap = self._pages_per_slot * ps
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        total = int(prompt.size) + int(req.max_new_tokens)
+        n_total = -(-total // ps)
+        entry, m = None, 0
+        ignore_handoff = False
+        if handoff is not None:
+            # the inject block spans ceil(bucket/ps) pages — pad KV
+            # beyond true_len lands in slot-owned pages (length-masked)
+            n_total = max(n_total, -(-int(handoff.k.shape[2]) // ps))
+            tl = int(handoff.true_len)
+            if (prompt.size > tl
+                    and tl + bucket_for(int(prompt.size) - tl,
+                                        self.min_bucket,
+                                        self.max_len) > cap):
+                # resume suffix bucket won't fit behind the handoff —
+                # fall back to a full cold prefill with the resume rng
+                # (same tokens: the chain is position-, not path-,
+                # dependent)
+                ignore_handoff = True
+                n_total = -(-total // ps)
+        elif self._prefix is not None:
+            entry, m = self._prefix.lookup(prompt)
+            suffix_bucket = bucket_for(int(prompt.size) - m,
+                                       self.min_bucket, self.max_len)
+            if entry is None or m < ps or m + suffix_bucket > cap:
+                # no usable share: sub-page matches aren't worth a
+                # fork, and the suffix bucket must fit the row
+                entry, m = None, 0
+        n_shared = m // ps
+        # registration: cold admissions (and warm ones the cache can't
+        # already serve maximally) register the FULL prompt; a partial
+        # boundary page is copied into a cache-owned page post-prefill
+        # so decode writes at >= len(prompt) never touch the entry
+        register = (handoff is None and self._prefix is not None
+                    and int(prompt.size) >= ps
+                    and m < int(prompt.size) - 1)
+        reg_partial = register and (int(prompt.size) % ps != 0)
+        n_fresh = n_total - n_shared
+        got = self._alloc_with_evict(n_fresh + (1 if reg_partial
+                                                else 0))
+        if got is None:
+            return None
+        fresh, reg_page = ((got[:-1], got[-1]) if reg_partial
+                           else (got, None))
+        row = np.zeros(n_total, np.int32)
+        fork = None
+        if entry is not None:
+            row[:n_shared] = entry.pages[:n_shared]
+            self._pages.retain(row[:n_shared])
+            if m % ps:
+                # the boundary page is shared but the suffix writes
+                # into it — fork it into the first fresh page
+                fork = (int(entry.pages[n_shared]), int(fresh[0]))
+            self._prefix.touch(entry)
+            self._prefix_hits += 1
+            self._m["prefix_hits"].inc()
+        elif handoff is None and self._prefix is not None:
+            self._prefix_misses += 1
+            self._m["prefix_misses"].inc()
+        row[n_shared:] = fresh
+        reg = None
+        if register:
+            n_full = int(prompt.size) // ps
+            reg_pages = list(row[:n_full])
+            reg_copy = None
+            if reg_partial:
+                reg_copy = (int(row[n_full]), int(reg_page))
+                reg_pages.append(int(reg_page))
+            reg = {"tokens": tuple(int(t) for t in prompt),
+                   "n_tokens": int(prompt.size),
+                   "pages": reg_pages, "copy": reg_copy}
+        return {"row": row, "prefix_len": m, "fork": fork,
+                "register": reg, "ignore_handoff": ignore_handoff}
 
     def _run_admissions(self, picks, firsts: List[Tuple[int, Any]]
                         ) -> None:
         """Run the admission programs for already-seated picks (engine
         thread only — slot/cache state is loop-private)."""
-        for slot, rid, req, handoff in picks:
+        for slot, rid, req, handoff, plan in picks:
             with dtrace.use(req.ctx):
-                if handoff is not None:
+                if self.paged:
+                    if handoff is not None:
+                        firsts.append((rid, self._inject_into_paged(
+                            slot, handoff, req, plan)))
+                    else:
+                        firsts.append((rid, self._prefill_into_paged(
+                            slot, req, plan)))
+                elif handoff is not None:
                     firsts.append(
                         (rid, self._inject_into(slot, handoff)))
                 else:
@@ -584,6 +974,108 @@ class ServeEngine:
             self._slot_len[slot] = h.true_len  # sums it under _lock
         return np.asarray([h.token], np.int32)
 
+    # -- paged admission programs --------------------------------------------
+    def _paged_prefill_fn(self, bucket: int):
+        fn = self._prefills.get(bucket)
+        if fn is None:
+            fn = telemetry.watch(
+                jax.jit(partial(llama.prefill_slot_paged, self.cfg,
+                                mesh=self.mesh), donate_argnums=(6,)),
+                f"serve_prefill_b{bucket}", expected=1)
+            self._prefills[bucket] = fn
+        return fn
+
+    def _run_paged_prefill(self, slot: int, req: Request, suffix,
+                           total_len: int, prefix_len: int):
+        """One warm/cold paged prefill: the SUFFIX tokens (end-padded
+        to their bucket) run at ``pos=prefix_len`` over the slot's
+        gathered pages. The suffix bucket is what keys the program, so
+        warm admissions hit SMALLER buckets than their full prompt
+        would — the prefix-share TTFT win."""
+        bucket = bucket_for(int(suffix.size), self.min_bucket,
+                            self.max_len)
+        fn = self._paged_prefill_fn(bucket)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :suffix.size] = suffix
+        key = (jax.random.PRNGKey(req.seed) if req.rng is None  # noqa: MXL301 — chain position 0 is PRNGKey(seed) by definition; the rng branch is a mid-chain resume key
+               else jax.numpy.asarray(np.asarray(req.rng, np.uint32)))
+        with self._span_prefill(bucket=bucket, role=self.role,
+                                prefix_len=prefix_len):
+            tok, self._kv, self._sv = fn(
+                self.params, padded, np.int32(total_len),
+                np.int32(prefix_len), self._pt[slot].copy(),
+                np.int32(slot), self._kv, self._sv, key,
+                np.float32(req.temperature),
+                np.int32(self.cfg.vocab_size if req.top_k is None
+                         else req.top_k),
+                np.float32(1.0 if req.top_p is None else req.top_p))
+        with self._lock:
+            self._slot_len[slot] = total_len
+        return tok
+
+    def _prefill_into_paged(self, slot: int, req: Request, plan):
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        m = plan["prefix_len"]
+        if plan["fork"] is not None:
+            # CoW: the suffix writes into the shared boundary page —
+            # give this slot a private copy first (the copy program
+            # and the prefill order by data dependency on the pool)
+            src, dst = plan["fork"]
+            self._kv = self._copy_fn(self._kv, np.int32(src),
+                                     np.int32(dst))
+            with self._lock:
+                self._cow_forks += 1
+            self._m["cow"].inc()
+        tok = self._run_paged_prefill(slot, req, prompt[m:],
+                                      int(prompt.size), m)
+        reg = plan["register"]
+        if reg is not None:
+            if reg["copy"] is not None:
+                # the entry's partial boundary page is a cache-owned
+                # COPY of the slot's — decode writes past the prompt
+                # must never leak into the registered prefix
+                src, dst = reg["copy"]
+                self._kv = self._copy_fn(self._kv, np.int32(src),
+                                         np.int32(dst))
+            with self._lock:
+                self._prefix.insert(reg["tokens"], reg["n_tokens"],
+                                    reg["pages"])
+                if reg["copy"] is not None:
+                    # insert() retains; drop the planner's temp hold
+                    self._pages.release([reg["copy"][1]])
+        return tok
+
+    def _inject_into_paged(self, slot: int, h: KVHandoff,
+                           req: Request, plan):
+        """Paged admission of a handed-off prefill; when the request's
+        prompt is LONGER than the handoff (journaled-page resume after
+        a crash), the emitted suffix warm-prefills over the injected
+        pages — one admission, no prefill-worker round trip."""
+        if plan.get("ignore_handoff"):
+            return self._prefill_into_paged(slot, req, plan)
+        bucket = int(h.k.shape[2])
+        fn = self._injects.get(bucket)
+        if fn is None:
+            fn = telemetry.watch(
+                jax.jit(partial(llama.inject_paged_kv, self.cfg,
+                                mesh=self.mesh), donate_argnums=(7,)),
+                f"serve_inject_b{bucket}", expected=1)
+            self._injects[bucket] = fn
+        with self._span_prefill(bucket=bucket, inject=True,
+                                role=self.role):
+            self._kv, self._sv = fn(
+                h.k, h.v, np.int32(h.true_len), self._pt[slot].copy(),
+                np.int32(slot), np.int32(h.token),
+                np.asarray(h.rng, np.uint32), self._kv, self._sv)
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if prompt.size > h.true_len:
+            return self._run_paged_prefill(
+                slot, req, prompt[h.true_len:], int(prompt.size),
+                int(h.true_len))
+        with self._lock:
+            self._slot_len[slot] = h.true_len
+        return np.asarray([h.token], np.int32)
+
     def _seat(self, slot: int, rid: int, req: Request) -> None:
         self._active[slot] = True
         self._temps[slot] = req.temperature
@@ -597,9 +1089,17 @@ class ServeEngine:
         # host DISPATCH time only — the program runs async; device time
         # belongs to the XLA trace (no sync in the decode loop, MXL004)
         with self._span_decode():
-            sampled, self._kv, self._sv = self._decode(
-                self.params, self._kv, self._sv, self._active,
-                self._temps, self._topks, self._topps)
+            if self.paged:
+                # the page table rides as a small int32 operand —
+                # table edits at admission never touch device state
+                # or the jit cache key
+                sampled, self._kv, self._sv = self._decode(
+                    self.params, self._kv, self._sv, self._active,
+                    self._pt, self._temps, self._topks, self._topps)
+            else:
+                sampled, self._kv, self._sv = self._decode(
+                    self.params, self._kv, self._sv, self._active,
+                    self._temps, self._topks, self._topps)
         self._m["steps"].inc()
         with self._lock:
             self.steps_run += 1
@@ -651,7 +1151,19 @@ class ServeEngine:
                 if self._done.get(rid, True):
                     self._active[slot] = False   # recycle at the next
                     self._slot_rid[slot] = None  # step boundary
+                    if self.paged:
+                        # release the slot's page hold; prefix-cache
+                        # entries keep their own refs, so shared pages
+                        # survive the request that seeded them
+                        row = self._pt[slot]
+                        held = [int(p) for p in row if p]
+                        if held:
+                            self._pages.release(held)
+                        row[:] = 0
             self._m["slots"].set(int(self._active.sum()))
+            if self.paged:
+                self._m["pages_free"].set(self._pages.free_pages)
+                self._m["pages_shared"].set(self._pages.shared_pages)
             live = (int(self._slot_len[self._active].sum())
                     * self._kv_tok_bytes)
             self._m["kv_live"].set(live)
@@ -763,6 +1275,10 @@ class ServeEngine:
         # vacuously true exactly when a retrace bug could hide
         fns = ([self._decode] + list(self._prefills.values())
                + list(self._injects.values()))
+        if self.paged:
+            # the CoW fork/registration copy is ONE program (src/dst
+            # are traced scalars) — the paged bound is buckets + 2
+            fns.append(self._copy_fn)
         return int(sum(f._cache_size() for f in fns))
 
     @property
@@ -782,12 +1298,31 @@ class ServeEngine:
         with self._lock:
             active = int(self._active.sum())
             live_tokens = int(self._slot_len[self._active].sum())
+            out = {"slots": self.max_slots, "active": active,
+                   "reserved_bytes": self._kv_reserved}
+            if self.paged:
+                out.update({
+                    "paged": True,
+                    "page_size": self.page_size,
+                    "pages_total": self.n_pages - 1,
+                    "pages_free": self._pages.free_pages,
+                    "pages_used": self._pages.used_pages,
+                    "pages_shared": self._pages.shared_pages,
+                    "cow_forks": self._cow_forks,
+                    "prefix_hits": self._prefix_hits,
+                    "prefix_misses": self._prefix_misses,
+                    "prefix_entries": (len(self._prefix)
+                                       if self._prefix is not None
+                                       else 0),
+                    "top_prefixes": (self._prefix.top()
+                                     if self._prefix is not None
+                                     else []),
+                })
         live = live_tokens * self._kv_tok_bytes
-        return {"slots": self.max_slots, "active": active,
-                "reserved_bytes": self._kv_reserved,
-                "live_bytes": live,
-                "occupancy": (live / self._kv_reserved
-                              if self._kv_reserved else 0.0)}
+        out["live_bytes"] = live
+        out["occupancy"] = (live / self._kv_reserved
+                            if self._kv_reserved else 0.0)
+        return out
 
     def latency_stats(self) -> Dict[str, float]:
         """Per-token latency: p50/p99 over the gaps between a

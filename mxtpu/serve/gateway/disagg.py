@@ -57,7 +57,7 @@ from ...base import env_float, env_int, env_str
 from ...telemetry import distributed as dtrace
 from ...models import llama
 from ..engine import (KVHandoff, Request, ServeEngine, bucket_for,
-                      cancel_counter)
+                      cancel_counter, _env_int)
 from .replica import (EngineReplica, NoHealthyReplicas, ReplicaSet,
                       Ticket)
 
@@ -318,7 +318,8 @@ class KVChannel:
                 # trace-context header acks exactly like a bare one
                 inner, _ctx = rpc.split_context(msg)
                 if (isinstance(inner, tuple) and len(inner) >= 2
-                        and inner[0] in ("kv", "kverr")):
+                        and inner[0] in ("kv", "kverr",
+                                         "kvpage", "kvdone")):
                     with self._send_lock:
                         rpc.send_msg(self._sock, ("kvack", inner[1]),
                                      self._secret)
@@ -381,6 +382,50 @@ def wire_to_handoff(msg: tuple) -> Tuple[int, KVHandoff]:
     _, rid, true_len, token, k, v, rng = msg
     return int(rid), KVHandoff(k=k, v=v, true_len=int(true_len),
                                token=int(token), rng=rng)
+
+
+def handoff_to_page_frames(rid: int, h: KVHandoff,
+                           page_size: int) -> List[tuple]:
+    """Page-granular wire encoding (the paged-KV handoff): the block
+    is TRIMMED to the page multiple covering ``true_len`` — prompt-
+    bucket padding never crosses the wire — and split into one
+    ``kvpage`` frame per page, closed by a ``kvdone`` frame carrying
+    the scalars. Each frame rides :meth:`KVChannel.send_handoff`
+    (acked, resend-safe: the receiver keys chunks by index, so a
+    resent page overwrites itself)."""
+    k, v = np.asarray(h.k), np.asarray(h.v)
+    n = min(k.shape[2], -(-int(h.true_len) // page_size) * page_size)
+    frames: List[tuple] = [
+        ("kvpage", int(rid), i // page_size,
+         k[:, :, i:i + page_size], v[:, :, i:i + page_size])
+        for i in range(0, n, page_size)]
+    frames.append(("kvdone", int(rid), int(h.true_len), int(h.token),
+                   np.asarray(h.rng, np.uint32), len(frames)))
+    return frames
+
+
+def pages_to_handoff(done: tuple,
+                     parts: Dict[int, Tuple[np.ndarray, np.ndarray]]
+                     ) -> Tuple[int, KVHandoff]:
+    """Reassemble a page-granular handoff from its ``kvdone`` frame +
+    the ``kvpage`` chunks received for that rid. A missing chunk is a
+    protocol error (the acked channel should make it impossible)."""
+    if not (isinstance(done, tuple) and len(done) == 6
+            and done[0] == "kvdone"):
+        raise rpc.RPCProtocolError(
+            f"not a kvdone frame: {str(done)[:80]}")
+    _, rid, true_len, token, rng, n_chunks = done
+    missing = [i for i in range(int(n_chunks)) if i not in parts]
+    if missing:
+        raise rpc.RPCProtocolError(
+            f"kv handoff rid={rid} missing page chunks {missing[:8]}")
+    k = np.concatenate([parts[i][0] for i in range(int(n_chunks))],
+                       axis=2)
+    v = np.concatenate([parts[i][1] for i in range(int(n_chunks))],
+                       axis=2)
+    return int(rid), KVHandoff(k=k, v=v, true_len=int(true_len),
+                               token=int(token),
+                               rng=np.asarray(rng, np.uint32))
 
 
 class CircuitBreaker:
@@ -493,12 +538,17 @@ class PrefillWorker:
                  min_bucket: int, max_len: int, mesh=None,
                  name: str = "p0",
                  on_fail: Optional[Callable[[int, str],
-                                            None]] = None):
+                                            None]] = None,
+                 wire_page_size: Optional[int] = None):
         self.cfg = cfg
         self.params = params
         self.channel = channel
         self.min_bucket = min_bucket
         self.max_len = max_len
+        # page-granular handoff (paged decode pool): ship the block as
+        # one acked frame per KV page, trimmed to the pages true_len
+        # covers — bucket padding never crosses the wire
+        self.wire_page_size = wire_page_size
         self.mesh = mesh
         self.name = name
         self.on_fail = on_fail
@@ -620,10 +670,20 @@ class PrefillWorker:
                           true_len=int(prompt.size),
                           token=int(np.asarray(tok)[0]),
                           rng=np.asarray(rng, np.uint32))
-            frame = handoff_to_wire(rid, h)
-            if ctx is not None:
-                frame = rpc.attach_context(frame, ctx.to_wire())
-            self.channel.send_handoff(frame)
+            if self.wire_page_size:
+                # the trace context rides the CLOSING frame — that is
+                # the one the feeder seats from
+                for frame in handoff_to_page_frames(
+                        rid, h, int(self.wire_page_size)):
+                    if ctx is not None and frame[0] == "kvdone":
+                        frame = rpc.attach_context(frame,
+                                                   ctx.to_wire())
+                    self.channel.send_handoff(frame)
+            else:
+                frame = handoff_to_wire(rid, h)
+                if ctx is not None:
+                    frame = rpc.attach_context(frame, ctx.to_wire())
+                self.channel.send_handoff(frame)
         except rpc.RPCAuthError:
             raise                   # misconfiguration: die loudly
         except (ConnectionError, OSError) as e:
@@ -678,7 +738,13 @@ class DisaggBackend:
                  min_bucket: Optional[int] = None, mesh=None,
                  channel: Optional[Tuple[KVChannel, KVChannel]] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 clock=None, started: bool = True):
+                 clock=None, started: bool = True,
+                 paged: bool = False,
+                 page_size: Optional[int] = None,
+                 n_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 int8_pages: Optional[bool] = None,
+                 kv_journal: Optional[int] = None):
         max_len = int(max_len or cfg.max_seq_len)
         min_bucket = int(min_bucket or 16)
         self._cfg = cfg
@@ -686,13 +752,41 @@ class DisaggBackend:
         self._mesh = mesh
         self._min_bucket = min_bucket
         self._mlen = max_len
+        # paged decode pool: page-granular wire + journaled handoffs
+        self.paged = bool(paged)
+        self._wire_ps = (int(page_size
+                             or _env_int("MXTPU_KV_PAGE_SIZE", 16))
+                         if self.paged else None)
         tx, rx = channel if channel is not None else KVChannel.pair()
         self._tx, self._rx = tx, rx
         self.decode = ReplicaSet(
             lambda: ServeEngine(cfg, params, max_slots=max_slots,
                                 max_len=max_len, min_bucket=min_bucket,
-                                mesh=mesh, clock=clock),
+                                mesh=mesh, clock=clock,
+                                paged=paged, page_size=page_size,
+                                n_pages=n_pages,
+                                prefix_cache=prefix_cache,
+                                int8_pages=int8_pages),
             n_decode, started=started)
+        # feeder-thread-only reassembly buffers: rid -> {chunk: (k,v)}
+        self._parts: Dict[int, Dict[int, Tuple[np.ndarray,
+                                               np.ndarray]]] = {}
+        # KV journal (paged re-dispatch seam): the last N seated
+        # handoffs, keyed by their prompt tokens — a crash re-dispatch
+        # whose prompt EXTENDS a journaled one re-seats the pages and
+        # warm-prefills only the emitted suffix, instead of burning a
+        # prefill-worker pass on the whole prompt
+        cap = (kv_journal if kv_journal is not None
+               else (32 if self.paged else 0))
+        self._journal_cap = max(0, int(cap))
+        self._journal: "Dict[Tuple[int, ...], KVHandoff]" = {}
+        self._m_journal_hits = telemetry.counter(
+            "gateway_kv_journal_hits_total",
+            "Crash re-dispatches seated from the KV journal (paged "
+            "inject + suffix warm prefill, no full re-prefill)")
+        self._m_page_frames = telemetry.counter(
+            "gateway_kv_page_frames_total",
+            "kvpage frames received on the page-granular handoff wire")
         self._wseq = itertools.count()
         self.prefill: List[PrefillWorker] = [
             self._new_worker() for _ in range(max(1, n_prefill))]
@@ -724,7 +818,8 @@ class DisaggBackend:
             self._cfg, self._params, self._tx,
             min_bucket=self._min_bucket, max_len=self._mlen,
             mesh=self._mesh, name=f"p{next(self._wseq)}",
-            on_fail=self._fail_pending)
+            on_fail=self._fail_pending,
+            wire_page_size=self._wire_ps)
 
     def _fail_pending(self, rid: int, reason: str = "error") -> None:
         """Finalize a pending request whose prefill/handoff failed
@@ -739,10 +834,53 @@ class DisaggBackend:
             if entry[0].on_done is not None:
                 entry[0].on_done(rid, reason)
 
+    # -- KV journal (paged re-dispatch) --------------------------------------
+    def _journal_put(self, prompt: np.ndarray,
+                     handoff: KVHandoff) -> None:
+        if self._journal_cap <= 0:
+            return
+        key = tuple(int(t) for t in prompt)
+        with self._lock:
+            self._journal.pop(key, None)     # refresh insertion order
+            self._journal[key] = handoff
+            while len(self._journal) > self._journal_cap:
+                self._journal.pop(next(iter(self._journal)))
+
+    def _journal_lookup(self, prompt: np.ndarray
+                        ) -> Optional[KVHandoff]:
+        """Longest journaled prompt that is a STRICT prefix of
+        ``prompt`` — the re-dispatch prompt is ``original + emitted``,
+        so the original's handoff matches here."""
+        pt = tuple(int(t) for t in prompt)
+        with self._lock:
+            best = None
+            for key, h in self._journal.items():
+                if (len(key) < len(pt) and pt[:len(key)] == key
+                        and (best is None
+                             or len(key) > best[0])):
+                    best = (len(key), h)
+            return best[1] if best is not None else None
+
     # -- Gateway surface -----------------------------------------------------
     def route(self, req: Request, handoff=None) -> "Ticket":
         if handoff is not None:
             return self.decode.route(req, handoff=handoff)
+        if self.paged and req.rng is not None \
+                and self._journal_cap > 0:
+            # a resume chain (crash re-dispatch): if the journal holds
+            # the original prompt's pages, seat them directly — the
+            # engine injects the pages and warm-prefills only the
+            # emitted suffix; bit-identical (same rng chain) but no
+            # prefill-pool round trip
+            rp = np.asarray(req.prompt, np.int32).reshape(-1)
+            jh = self._journal_lookup(rp)
+            if jh is not None and int(rp.size) + int(
+                    req.max_new_tokens) <= self._mlen:
+                self._m_journal_hits.inc()
+                telemetry.flight().record(
+                    "gateway", "kv_journal_hit",
+                    prefix=int(jh.true_len), prompt=int(rp.size))
+                return self.decode.route(req, handoff=jh)
         # validate NOW (the prefill thread can only log, not raise to
         # the caller) — same checks ServeEngine.submit applies
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
@@ -808,6 +946,8 @@ class DisaggBackend:
                    for r in self.decode.state()]
                 + [dict(name="handoff", role="channel", alive=True,
                         queued=n_pending, active=0, slots=0,
+                        paged=self.paged,
+                        kv_journal=len(self._journal),
                         breaker=self.breaker.describe())])
 
     # -- supervisor surface (decode pool) ------------------------------------
@@ -901,6 +1041,7 @@ class DisaggBackend:
             if (isinstance(msg, tuple) and len(msg) == 3
                     and msg[0] == "kverr"):
                 rid, err = int(msg[1]), msg[2]
+                self._parts.pop(rid, None)   # orphaned page chunks
                 self.breaker.record_failure()
                 with self._lock:
                     entry = self._pending.pop(rid, None)
@@ -910,8 +1051,21 @@ class DisaggBackend:
                 if entry is not None:
                     self._count_cancel("error")
                 continue
+            if (isinstance(msg, tuple) and len(msg) == 5
+                    and msg[0] == "kvpage"):
+                # one page of an in-flight handoff: buffer by chunk
+                # index (idempotent — a resent chunk overwrites itself)
+                self._parts.setdefault(
+                    int(msg[1]), {})[int(msg[2])] = (msg[3], msg[4])
+                self._m_page_frames.inc()
+                continue
             try:
-                rid, handoff = wire_to_handoff(msg)
+                if (isinstance(msg, tuple) and msg
+                        and msg[0] == "kvdone"):
+                    rid, handoff = pages_to_handoff(
+                        msg, self._parts.pop(int(msg[1]), {}))
+                else:
+                    rid, handoff = wire_to_handoff(msg)
             except rpc.RPCProtocolError as e:
                 # a foreign frame means the stream is desynced — stop
                 # feeding loudly rather than seat corrupt state
@@ -960,6 +1114,11 @@ class DisaggBackend:
                 if req.on_done is not None:
                     req.on_done(rid, "error")
                 continue
+            # the journal keeps the seated handoff's host bytes: a
+            # decode-replica crash re-seats THESE pages instead of
+            # re-running the whole prompt through the prefill pool
+            self._journal_put(
+                np.asarray(req.prompt, np.int32).reshape(-1), handoff)
             with self._lock:
                 ticket.seated = seated
                 reason = ticket.cancelled_reason

@@ -1002,6 +1002,32 @@ class Gateway:
                     "active": sum(r["active"] for r in kv_rows),
                     "reserved_bytes": reserved, "live_bytes": live,
                     "occupancy": (live / reserved) if reserved else 0.0}
+        paged_rows = [r for r in kv_rows if r.get("paged")]
+        if paged_rows:
+            # paged-pool fleet view (.get() guards: a mixed fleet may
+            # carry dense replicas whose rows lack these fields)
+            hits = sum(r.get("prefix_hits", 0) for r in paged_rows)
+            misses = sum(r.get("prefix_misses", 0)
+                         for r in paged_rows)
+            tops = [p for r in paged_rows
+                    for p in r.get("top_prefixes", [])]
+            tops.sort(key=lambda p: -p.get("hits", 0))
+            kv_cache.update({
+                "paged": True,
+                "pages_total": sum(r.get("pages_total", 0)
+                                   for r in paged_rows),
+                "pages_free": sum(r.get("pages_free", 0)
+                                  for r in paged_rows),
+                "pages_used": sum(r.get("pages_used", 0)
+                                  for r in paged_rows),
+                "pages_shared": sum(r.get("pages_shared", 0)
+                                    for r in paged_rows),
+                "cow_forks": sum(r.get("cow_forks", 0)
+                                 for r in paged_rows),
+                "prefix_hits": hits, "prefix_misses": misses,
+                "prefix_hit_rate": (hits / (hits + misses)
+                                    if hits + misses else 0.0),
+                "top_prefixes": tops[:5]})
         return {"replicas": replicas,
                 "kv_cache": kv_cache,
                 "n_replicas": self.backend.size,

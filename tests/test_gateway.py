@@ -214,6 +214,7 @@ def test_engine_deadline_fake_clock(cfg, params):
 # ---------------------------------------------------------------------------
 # the gateway: Poisson multi-client stream, 2 replicas, bit-identity
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # ~26s; fresh-process contract home: gateway_smoke
 def test_gateway_two_replicas_poisson_bit_identical(cfg, params):
     """12 seeded clients with Poisson-spaced arrivals hammer the HTTP
     front door over 2 engine replicas (mixed lengths + sampling

@@ -42,6 +42,7 @@ def test_llama_scan_matches_unrolled(tiny_cfg):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow   # ~23s; ci_all's unittest_cpu_mesh runs the full suite
 def test_chunked_ce_matches_full(tiny_cfg):
     """VERDICT r2 #5: the streaming chunked cross-entropy must match
     the materialized log_softmax path in value AND gradient, including
@@ -132,7 +133,8 @@ def test_llama_generate(tiny_cfg):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_llama_generate_topk_topp(tiny_cfg):
+@pytest.mark.slow   # ~18s; sampler modes also pinned in test_serve's
+def test_llama_generate_topk_topp(tiny_cfg):    # traced==static gate
     """top-k / nucleus sampling (round 4): every sampled token must lie
     inside the allowed set at its position, sampling is deterministic
     given the rng, and bad arguments raise."""
@@ -542,6 +544,7 @@ def test_resnet_s2d_stem_rejects_odd_input():
         resnet.forward(cfg, params, x)
 
 
+@pytest.mark.slow   # ~17s; fresh-process home: multichip_dryrun CI stage
 def test_graft_entry():
     import __graft_entry__ as g
     fn, args = g.entry()

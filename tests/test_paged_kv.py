@@ -1,0 +1,318 @@
+"""Paged KV cache with copy-on-write prefix sharing (ISSUE 18).
+
+Contracts:
+- :class:`PageAllocator` is all-or-nothing with exact refcounts: a
+  failed grant leaves the pool untouched (admission backpressure, not
+  a crash), shared pages free only on their LAST release, and the
+  scratch page 0 can never be allocated, retained, or released;
+- :func:`paged_decode_attention` over a scattered page pool is
+  BIT-identical to :func:`slot_decode_attention` over the dense bank
+  it was paged from — including when two slots alias the same
+  physical pages (the sharing read path);
+- a paged ``ServeEngine`` streams tokens bit-identical to per-request
+  ``llama.generate`` across mixed prompts and sampling configs, and a
+  shared system prompt produces prefix-cache hits + a CoW boundary
+  fork WITHOUT changing a single token;
+- a pool too small for the offered load queues (admission
+  backpressure) and still drains every request bit-exactly;
+- a journaled page-table restore (``submit_prefilled`` with a resume
+  rng mid-stream) continues the stream exactly where the crashed
+  engine left off.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxtpu.models import llama
+from mxtpu.ops.attention import paged_decode_attention, \
+    slot_decode_attention
+from mxtpu.serve import Request, ServeEngine
+from mxtpu.serve.engine import KVHandoff, PageAllocator, PrefixCache, \
+    resume_key
+
+import llama_refs
+
+
+@pytest.fixture(scope="module")
+def cfg(serve_cfg):
+    return serve_cfg
+
+
+@pytest.fixture(scope="module")
+def params(serve_params):
+    return serve_params
+
+
+def paged_engine(cfg, params, **kw):
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 8)
+    return llama_refs.engine_factory(cfg, params, **kw)()
+
+
+# ---------------------------------------------------------------------------
+# allocator: refcounts, all-or-nothing grants, scratch-page protection
+# ---------------------------------------------------------------------------
+def test_page_allocator_alloc_release_refcount():
+    a = PageAllocator(6)                    # scratch + 5 usable
+    assert a.free_pages == 5 and a.used_pages == 0
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3 and 0 not in got
+    assert a.free_pages == 2 and a.used_pages == 3
+    assert all(a.refcount(p) == 1 for p in got)
+    # share two of them (prefix-cache hold), then release the slot's
+    # ownership: shared pages must survive the first release
+    a.retain(got[:2])
+    assert a.shared_pages == 2
+    a.release(got)
+    assert a.free_pages == 3                # only the unshared one freed
+    assert [a.refcount(p) for p in got] == [1, 1, 0]
+    a.release(got[:2])                      # cache lets go -> all free
+    assert a.free_pages == 5 and a.shared_pages == 0
+
+
+def test_page_allocator_exhaustion_is_all_or_nothing():
+    a = PageAllocator(4)                    # 3 usable
+    assert a.alloc(4) is None               # over-ask: no partial grant
+    assert a.free_pages == 3                # pool untouched
+    got = a.alloc(3)
+    assert a.alloc(1) is None and a.free_pages == 0
+    a.release(got[:1])
+    assert a.alloc(1) is not None           # freed page is grantable
+
+
+def test_page_allocator_guards_scratch_and_dead_pages():
+    a = PageAllocator(4)
+    with pytest.raises(ValueError):
+        a.retain([0])                       # scratch page
+    with pytest.raises(ValueError):
+        a.release([0])
+    with pytest.raises(ValueError):
+        a.retain([2])                       # never allocated
+    got = a.alloc(1)
+    a.release(got)
+    with pytest.raises(ValueError):
+        a.release(got)                      # double free
+    with pytest.raises(ValueError):
+        a.alloc(-1)
+    with pytest.raises(ValueError):
+        PageAllocator(1)                    # scratch alone is not a pool
+
+
+def test_prefix_cache_longest_common_prefix_and_eviction():
+    a = PageAllocator(10)
+    c = PrefixCache(a, max_entries=2)
+    pages = a.alloc(2)
+    # entry covers 8 tokens of a 10-token registered prompt; the
+    # cache retains its OWN hold, so the caller can let go
+    c.insert(list(range(10)), 8, pages)
+    a.release(pages)
+    e, m = c.lookup(list(range(6)) + [99, 98])
+    assert e is not None and m == 6         # divergent suffix still hits
+    e, m = c.lookup(list(range(10)) + [50])
+    assert m == 8                           # capped at covered tokens
+    e, m = c.lookup([77, 78, 79])
+    assert e is None and m == 0
+    # last prompt token never comes from cache (its logits seed the
+    # first sample): lookup of the exact prompt is capped at len-1
+    e, m = c.lookup(list(range(8)))
+    assert m == 7
+    # over-capacity insert evicts LRU and releases its page hold:
+    # two 1-page allocs out, the evicted entry's 2 pages back
+    free0 = a.free_pages
+    p1 = a.alloc(1)
+    c.insert([201], 1, p1)
+    a.release(p1)
+    p2 = a.alloc(1)
+    c.insert([202], 1, p2)                  # cap 2 -> first entry out
+    a.release(p2)
+    assert len(c) == 2 and a.free_pages == free0
+
+
+# ---------------------------------------------------------------------------
+# kernel: paged gather == dense slot attention, bit for bit
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("S,hq,hkv", [(1, 4, 4), (3, 4, 4), (6, 8, 2)])
+def test_paged_attention_matches_slot_attention(S, hq, hkv):
+    rng = np.random.default_rng(11)
+    max_len, hd, ps = 48, 16, 8
+    ppr = max_len // ps
+    q = jnp.asarray(rng.standard_normal((S, hq, 1, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, hkv, max_len, hd)),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, hkv, max_len, hd)),
+                    jnp.float32)
+    lengths = jnp.asarray(
+        [int(x) for x in rng.integers(1, max_len + 1, S)])
+    # scatter each slot's dense bank into a shuffled page pool (page 0
+    # reserved as scratch), then read it back through the page table
+    n_pages = 1 + S * ppr
+    perm = rng.permutation(np.arange(1, n_pages))
+    table = np.asarray(perm, np.int32).reshape(S, ppr)
+    kp = np.zeros((n_pages, hkv, ps, hd), np.float32)
+    vp = np.zeros((n_pages, hkv, ps, hd), np.float32)
+    for s in range(S):
+        for j in range(ppr):
+            kp[table[s, j]] = np.asarray(k[s, :, j * ps:(j + 1) * ps])
+            vp[table[s, j]] = np.asarray(v[s, :, j * ps:(j + 1) * ps])
+    ref = slot_decode_attention(q, k, v, lengths, kv_block=16)
+    out = paged_decode_attention(q, jnp.asarray(kp), jnp.asarray(vp),
+                                 jnp.asarray(table), lengths,
+                                 kv_block=16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_paged_attention_shared_pages_read_path():
+    """Two slots whose tables alias the SAME physical prefix pages
+    (CoW sharing before any fork) read identical prefixes."""
+    rng = np.random.default_rng(12)
+    hkv, hq, hd, ps = 2, 4, 16, 8
+    kp = jnp.asarray(rng.standard_normal((5, hkv, ps, hd)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((5, hkv, ps, hd)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((2, hq, 1, hd)), jnp.float32)
+    table = jnp.asarray([[1, 2], [1, 3]], jnp.int32)   # page 1 shared
+    lengths = jnp.asarray([8, 8])                      # prefix only
+    out = paged_decode_attention(jnp.repeat(q[:1], 2, 0), kp, vp,
+                                 table, lengths)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(out[1]))
+
+
+# ---------------------------------------------------------------------------
+# engine: paged streams == generate oracle; sharing changes no tokens
+# ---------------------------------------------------------------------------
+@pytest.mark.slow   # ~15s; fresh-process home: paged_kv_smoke (ci_fast)
+def test_paged_engine_bit_exact_with_prefix_sharing(cfg, params):
+    shared = [7, 3, 9, 1, 5, 2, 8, 4, 6]   # 9 toks > page_size 8
+    reqs = [
+        dict(prompt=shared + [11, 12], max_new_tokens=6,
+             temperature=1.0, seed=0),
+        dict(prompt=shared + [13], max_new_tokens=6, temperature=1.0,
+             seed=1),
+        dict(prompt=[21, 22, 23], max_new_tokens=5, temperature=0.0),
+        dict(prompt=shared + [14, 15], max_new_tokens=4,
+             temperature=1.0, top_k=8, seed=3),
+    ]
+    e = paged_engine(cfg, params)
+    rids = [e.submit(Request(**r)) for r in reqs]
+    out = e.run()
+    for rid, r in zip(rids, reqs):
+        want = llama_refs.reference(
+            cfg, params, r["prompt"], r["max_new_tokens"],
+            seed=r.get("seed", 0), temperature=r["temperature"],
+            top_k=r.get("top_k"))
+        assert [int(t) for t in out[rid]] == want
+    st = e.kv_cache_stats()
+    assert st["prefix_hits"] >= 1, st       # the shared system prompt
+    assert st["cow_forks"] >= 1, st         # 9 % 8 -> boundary fork
+    assert st["prefix_entries"] >= 1, st
+    # churn never retraces: buckets + decode + copy_page
+    assert e.compile_count <= e.n_buckets + 2, (e.compile_count,
+                                               e.n_buckets)
+    # warm wave: hits again, still bit-exact
+    p2 = shared + [31]
+    rid2 = e.submit(Request(prompt=p2, max_new_tokens=5,
+                            temperature=1.0, seed=7))
+    got2 = [int(t) for t in e.run()[rid2]]
+    assert got2 == llama_refs.reference(cfg, params, p2, 5, seed=7,
+                                        temperature=1.0)
+    assert e.kv_cache_stats()["prefix_hits"] > st["prefix_hits"]
+
+
+@pytest.mark.slow   # ~11s; paged_kv_smoke drives pool-bound admission
+def test_paged_pool_exhaustion_backpressures_and_drains(cfg, params):
+    # max_len=32, ps=8 -> 4 pages/slot; 5 usable pages < 2 full slots
+    e = paged_engine(cfg, params, n_pages=6, prefix_cache=False)
+    reqs = [([41, 42, 43], 4, 0), ([44, 45], 4, 1), ([46], 4, 2)]
+    rids = [e.submit(Request(prompt=p, max_new_tokens=m,
+                             temperature=1.0, seed=s))
+            for (p, m, s) in reqs]
+    out = e.run()                           # queues, never crashes
+    for rid, (p, m, s) in zip(rids, reqs):
+        assert [int(t) for t in out[rid]] == llama_refs.reference(
+            cfg, params, p, m, seed=s, temperature=1.0)
+    assert e.kv_cache_stats()["pages_used"] == 0   # fully drained
+
+
+def test_paged_journaled_restore_resumes_stream(cfg, params):
+    """Crash re-dispatch: prefill once (detached), emit 2 tokens,
+    'crash', then seat the journaled handoff + page table in a FRESH
+    engine with the resume rng — the stream continues bit-exactly."""
+    prompt, mnew, seed = [51, 52, 53, 54, 55], 6, 9
+    full = llama_refs.reference(cfg, params, prompt, mnew, seed=seed,
+                                temperature=1.0)
+    padded = np.zeros((1, 8), np.int32)     # bucket 8 covers len 5
+    padded[0, :len(prompt)] = prompt
+    tok, kb, vb, rng = llama.prefill_detached(
+        cfg, params, jnp.asarray(padded), np.int32(len(prompt)),
+        jax.random.PRNGKey(seed), np.float32(1.0),
+        np.int32(cfg.vocab_size), np.float32(1.0))
+    assert int(np.asarray(tok)[0]) == full[0]
+    h = KVHandoff(k=np.asarray(kb), v=np.asarray(vb),
+                  true_len=len(prompt), token=full[0],
+                  rng=np.asarray(rng, np.uint32))
+    n_em = 2
+    e = paged_engine(cfg, params)
+    rid = e.submit_prefilled(h, Request(
+        prompt=prompt + full[:n_em], max_new_tokens=mnew - n_em,
+        temperature=1.0, rng=resume_key(seed, n_em)))
+    assert [int(t) for t in e.run()[rid]] == full[n_em:]
+    # plain (no-resume) handoff through the paged inject path, too
+    e2 = paged_engine(cfg, params)
+    rid2 = e2.submit_prefilled(h, Request(
+        prompt=prompt, max_new_tokens=mnew, temperature=1.0,
+        seed=seed))
+    assert [int(t) for t in e2.run()[rid2]] == full
+
+
+@pytest.mark.slow
+def test_paged_int8_pool_deterministic(cfg, params):
+    """The int8-per-page pool is self-consistent: two engines, same
+    stream (quantized KV is NOT f32-bit-exact, so the contract is
+    determinism, matching the dense int8 cache's)."""
+    p = [7, 3, 9, 1, 5, 2, 8, 4, 6, 61, 62]
+    outs = []
+    for _ in range(2):
+        e = paged_engine(cfg, params, int8_pages=True)
+        rid = e.submit(Request(prompt=p, max_new_tokens=5,
+                               temperature=1.0, seed=4))
+        outs.append([int(t) for t in e.run()[rid]])
+    assert outs[0] == outs[1]
+
+
+@pytest.mark.slow
+def test_disagg_paged_wire_and_journal(cfg, params):
+    """Page-granular KV wire + journal-hit crash re-dispatch through
+    DisaggBackend: streams bit-exact, kvpage frames flow, a resume
+    re-dispatch seats from the journal without a prefill round trip."""
+    import threading
+    from mxtpu.serve.gateway.disagg import DisaggBackend
+
+    def run_req(be, prompt, mnew, seed=0, rng=None):
+        toks, done = [], threading.Event()
+        req = Request(prompt=prompt, max_new_tokens=mnew,
+                      temperature=1.0, seed=seed, rng=rng,
+                      on_token=lambda rid, t: toks.append(int(t)),
+                      on_done=lambda rid, r: done.set())
+        be.route(req)
+        assert done.wait(120)
+        return toks
+
+    be = DisaggBackend(cfg, params, n_prefill=1, n_decode=1,
+                       max_slots=2, max_len=32, min_bucket=4,
+                       paged=True, page_size=8)
+    try:
+        p1 = [7, 3, 9, 1, 5, 2, 8, 4, 6, 11, 12]
+        full = llama_refs.reference(cfg, params, p1, 6, seed=0,
+                                    temperature=1.0)
+        assert run_req(be, p1, 6, seed=0) == full
+        assert int(be._m_page_frames.value) >= 2   # 11 toks / ps 8
+        assert len(be._journal) == 1
+        # crash after 2 emitted -> journal hit, decode-side reseat
+        got = run_req(be, p1 + full[:2], 4, seed=0,
+                      rng=resume_key(0, 2))
+        assert got == full[2:]
+        assert int(be._m_journal_hits.value) >= 1
+        row = be.state()[-1]
+        assert row["paged"] and row["kv_journal"] >= 1
+    finally:
+        be.close()

@@ -165,7 +165,8 @@ def test_serve_bit_identical_to_generate_poisson_stream(cfg, params):
         lat["p50_token_ms"] >= 0.0
 
 
-def test_serve_scheduling_never_changes_tokens(cfg, params):
+@pytest.mark.slow   # ~18s; bit-identity stays tier-1 via the Poisson
+def test_serve_scheduling_never_changes_tokens(cfg, params):  # stream test
     """Tokens are a per-request property: different slot counts and
     overlap modes (different interleavings of the same requests) must
     produce identical output."""

@@ -122,6 +122,67 @@ def gateway_state(addr: str = ""):
                   f"pressure={d['pressure']} p99={d['p99_ms']}")
 
 
+def kv_state(addr: str = ""):
+    """``python tools/diagnose.py kv <host:port>`` — the paged-KV
+    view of a running gateway, from ONE GET /state scrape: page-pool
+    occupancy, shared pages, prefix-cache hit rate, and the top shared
+    prefixes, fleet-aggregated and then per decode replica."""
+    addr = addr or os.environ.get("MXTPU_GATEWAY_ADDR", "")
+    if not addr:
+        return False
+    host, _, port = addr.partition(":")
+    print(f"----------KV cache ({addr})----------")
+    try:
+        from mxtpu.serve.gateway import GatewayClient
+        status, state = GatewayClient(host, int(port or 9300),
+                                      timeout=5.0).get_json("/state")
+    except Exception as e:
+        print(f"unreachable: {e!r}")
+        return False
+    if status != 200:
+        print(f"HTTP {status}: {state}")
+        return False
+    kv = state.get("kv_cache") or {}
+    occ = kv.get("occupancy", 0.0)
+    print(f"reserved={kv.get('reserved_bytes', 0):,}B "
+          f"live={kv.get('live_bytes', 0):,}B "
+          f"occupancy={occ:.3f} "
+          f"active={kv.get('active', 0)}/{kv.get('slots', 0)} slots")
+    if not kv.get("paged"):
+        print("paged: off (dense slot banks; see docs/serving.md "
+              "'Paged KV cache' to enable)")
+        return True
+    total = kv.get("pages_total", 0)
+    used = kv.get("pages_used", 0)
+    hits = kv.get("prefix_hits", 0)
+    misses = kv.get("prefix_misses", 0)
+    rate = kv.get("prefix_hit_rate",
+                  hits / (hits + misses) if hits + misses else 0.0)
+    print(f"pages: {used}/{total} used "
+          f"({kv.get('pages_free', 0)} free, "
+          f"{kv.get('pages_shared', 0)} shared) "
+          f"cow_forks={kv.get('cow_forks', 0)}")
+    print(f"prefix cache: hits={hits} misses={misses} "
+          f"hit_rate={rate:.3f}")
+    for p in kv.get("top_prefixes", []):
+        print(f"  prefix len={p.get('n_tokens')} "
+              f"hits={p.get('hits')} pages={p.get('pages')} "
+              f"head={p.get('head')}")
+    for r in state.get("replicas", []):
+        rkv = r.get("kv_cache") if isinstance(r, dict) else None
+        if not rkv or not rkv.get("paged"):
+            continue
+        print(f"  {r.get('name', '?'):<10} "
+              f"pages={rkv.get('pages_used', 0)}"
+              f"/{rkv.get('pages_total', 0)} "
+              f"shared={rkv.get('pages_shared', 0)} "
+              f"hits={rkv.get('prefix_hits', 0)} "
+              f"misses={rkv.get('prefix_misses', 0)} "
+              f"cow={rkv.get('cow_forks', 0)} "
+              f"entries={rkv.get('prefix_entries', 0)}")
+    return True
+
+
 def fleet_state(addr: str = ""):
     """``python tools/diagnose.py fleet <host:port>`` — the fleet
     control plane at a glance, from ONE /state + ONE /metrics scrape
@@ -689,6 +750,13 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "perf":
         source = sys.argv[2] if len(sys.argv) > 2 else ""
         sys.exit(0 if perf(source) else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "kv":
+        addr = sys.argv[2] if len(sys.argv) > 2 else ""
+        if not addr and not os.environ.get("MXTPU_GATEWAY_ADDR"):
+            print("usage: diagnose.py kv <host:port>  (or set "
+                  "MXTPU_GATEWAY_ADDR)")
+            sys.exit(2)
+        sys.exit(0 if kv_state(addr) else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         addr = sys.argv[2] if len(sys.argv) > 2 else ""
         if not addr and not os.environ.get("MXTPU_GATEWAY_ADDR"):
