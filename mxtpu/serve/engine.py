@@ -259,9 +259,17 @@ class PrefixCache:
                 best, best_m = e, m
         return best, best_m
 
-    def touch(self, entry: _PrefixEntry) -> None:
+    def pin(self, entry: _PrefixEntry) -> None:
+        """Freshen an entry's LRU position WITHOUT counting a hit —
+        the admission planner pins the matched entry before it
+        allocates, so pool-pressure eviction prefers every other
+        entry (a failed admission retries each step and must not
+        inflate the hit stats)."""
         self._tick += 1
         entry.last_used = self._tick
+
+    def touch(self, entry: _PrefixEntry) -> None:
+        self.pin(entry)
         entry.hits += 1
 
     def insert(self, tokens, n_tokens: int, pages) -> _PrefixEntry:
@@ -283,13 +291,20 @@ class PrefixCache:
         self._entries[key] = e
         return e
 
-    def evict_lru(self) -> bool:
+    def evict_lru(self, skip: Optional[_PrefixEntry] = None) -> bool:
         """Drop the least-recently-used entry, releasing its page hold.
-        Returns False when the cache is empty."""
-        if not self._entries:
+        ``skip`` exempts one pinned entry (the admission planner's
+        matched prefix — evicting it mid-plan would free the very
+        pages the plan is about to share). Returns False when nothing
+        is evictable."""
+        key, oldest = None, None
+        for k, e in self._entries.items():
+            if e is skip:
+                continue
+            if oldest is None or e.last_used < oldest:
+                key, oldest = k, e.last_used
+        if key is None:
             return False
-        key = min(self._entries,
-                  key=lambda k: self._entries[k].last_used)
         e = self._entries.pop(key)
         self._alloc.release(e.pages)
         return True
@@ -804,15 +819,20 @@ class ServeEngine:
         return picks
 
     # -- paged admission planning (lock held) --------------------------------
-    def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
+    def _alloc_with_evict(self, n: int,
+                          keep: Optional[_PrefixEntry] = None
+                          ) -> Optional[List[int]]:
         """All-or-nothing page grant; when the pool runs dry, evict
         prefix-cache entries LRU-first (their pages come back the
-        moment no live slot shares them) and retry."""
+        moment no live slot shares them) and retry. ``keep`` is the
+        plan's matched prefix entry — never evicted by its own
+        admission."""
         while True:
             pages = self._pages.alloc(n)
             if pages is not None:
                 return pages
-            if self._prefix is None or not self._prefix.evict_lru():
+            if self._prefix is None \
+                    or not self._prefix.evict_lru(skip=keep):
                 return None
 
     def _plan_pages(self, req: Request,
@@ -833,7 +853,8 @@ class ServeEngine:
         if handoff is not None:
             # the inject block spans ceil(bucket/ps) pages — pad KV
             # beyond true_len lands in slot-owned pages (length-masked)
-            n_total = max(n_total, -(-int(handoff.k.shape[2]) // ps))
+            n_total = max(n_total,
+                          self._inject_block_len(handoff) // ps)
             tl = int(handoff.true_len)
             if (prompt.size > tl
                     and tl + bucket_for(int(prompt.size) - tl,
@@ -863,9 +884,41 @@ class ServeEngine:
                     and m < int(prompt.size) - 1)
         reg_partial = register and (int(prompt.size) % ps != 0)
         n_fresh = n_total - n_shared
+        # Pin the matched entry and retain its pages BEFORE any
+        # eviction can run: under pool pressure _alloc_with_evict
+        # evicts prefix entries, and without a planner hold it could
+        # free (or re-hand as "fresh") the very pages this plan is
+        # about to share — retain() on a dead page would kill the
+        # loop, a re-handed one would alias two logical positions.
+        # The holds on the full shared pages transfer to the slot's
+        # row; the boundary-page hold pins the CoW fork source until
+        # the copy dispatches (_prefill_into_paged releases it).
+        hold: List[int] = []
+        if entry is not None:
+            hold = [int(p) for p in entry.pages[:n_shared]]
+            if m % ps:
+                hold.append(int(entry.pages[n_shared]))
+            self._pages.retain(hold)
+            self._prefix.pin(entry)
         got = self._alloc_with_evict(n_fresh + (1 if reg_partial
-                                                else 0))
+                                                else 0), keep=entry)
+        if got is None and entry is not None:
+            # even with every OTHER entry evicted the warm plan does
+            # not fit — drop the share and retry COLD, where the
+            # matched entry itself becomes evictable (a pinned entry
+            # must never wedge admission for good)
+            self._pages.release(hold)
+            hold, entry, m, n_shared = [], None, 0, 0
+            register = (handoff is None and self._prefix is not None
+                        and int(prompt.size) >= ps
+                        and 0 < int(prompt.size) - 1)
+            reg_partial = register and (int(prompt.size) % ps != 0)
+            n_fresh = n_total
+            got = self._alloc_with_evict(n_fresh + (1 if reg_partial
+                                                    else 0))
         if got is None:
+            if hold:
+                self._pages.release(hold)   # plan abandoned: unpin
             return None
         fresh, reg_page = ((got[:-1], got[-1]) if reg_partial
                            else (got, None))
@@ -873,10 +926,11 @@ class ServeEngine:
         fork = None
         if entry is not None:
             row[:n_shared] = entry.pages[:n_shared]
-            self._pages.retain(row[:n_shared])
             if m % ps:
                 # the boundary page is shared but the suffix writes
-                # into it — fork it into the first fresh page
+                # into it — fork it into the first fresh page (the
+                # planner's hold keeps the source live even if the
+                # entry is evicted before the copy runs)
                 fork = (int(entry.pages[n_shared]), int(fresh[0]))
             self._prefix.touch(entry)
             self._prefix_hits += 1
@@ -1025,6 +1079,9 @@ class ServeEngine:
                                      np.int32(dst))
             with self._lock:
                 self._cow_forks += 1
+                # the copy is dispatched (ordered by data dependency
+                # on the pool) — drop the planner's pin on the source
+                self._pages.release([src])
             self._m["cow"].inc()
         tok = self._run_paged_prefill(slot, req, prompt[m:],
                                       int(prompt.size), m)
@@ -1045,6 +1102,19 @@ class ServeEngine:
                     self._pages.release([reg["copy"][1]])
         return tok
 
+    def _inject_block_len(self, h: KVHandoff) -> int:
+        """The block length the paged inject program runs at. The
+        page-granular wire trims handoff blocks to the page multiple
+        covering ``true_len`` — an ARBITRARY multiple per prompt
+        length — so injecting at the wire shape would compile up to
+        max_len/page_size distinct programs. Pad back up to the
+        power-of-two bucket (page-rounded) instead: inject compiles
+        stay bounded by the bucket set, same as prefill."""
+        blk = int(h.k.shape[2])
+        b = bucket_for(blk, self.min_bucket, self.max_len)
+        b = -(-b // self.page_size) * self.page_size
+        return max(blk, b)
+
     def _inject_into_paged(self, slot: int, h: KVHandoff,
                            req: Request, plan):
         """Paged admission of a handed-off prefill; when the request's
@@ -1053,7 +1123,14 @@ class ServeEngine:
         pages — one admission, no prefill-worker round trip."""
         if plan.get("ignore_handoff"):
             return self._prefill_into_paged(slot, req, plan)
-        bucket = int(h.k.shape[2])
+        bucket = self._inject_block_len(h)
+        k, v = np.asarray(h.k), np.asarray(h.v)
+        if bucket > k.shape[2]:
+            # wire-trimmed block: zero-pad to the bucket (positions
+            # past true_len are length-masked, so the fill is inert)
+            pad = [(0, 0)] * k.ndim
+            pad[2] = (0, bucket - k.shape[2])
+            k, v = np.pad(k, pad), np.pad(v, pad)
         fn = self._injects.get(bucket)
         if fn is None:
             fn = telemetry.watch(
@@ -1064,7 +1141,7 @@ class ServeEngine:
         with self._span_prefill(bucket=bucket, inject=True,
                                 role=self.role):
             self._kv, self._sv = fn(
-                h.k, h.v, np.int32(h.true_len), self._pt[slot].copy(),
+                k, v, np.int32(h.true_len), self._pt[slot].copy(),
                 np.int32(slot), np.int32(h.token),
                 np.asarray(h.rng, np.uint32), self._kv, self._sv)
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)

@@ -775,10 +775,22 @@ class DisaggBackend:
         # handoffs, keyed by their prompt tokens — a crash re-dispatch
         # whose prompt EXTENDS a journaled one re-seats the pages and
         # warm-prefills only the emitted suffix, instead of burning a
-        # prefill-worker pass on the whole prompt
+        # prefill-worker pass on the whole prompt.
+        # COST: each entry pins a FULL host K/V block (layers x
+        # kv_heads x bucket x head_dim, k + v) — hundreds of MB on
+        # production-sized models — so the real bound is BYTES, not
+        # entries: oldest entries fall off once the total crosses
+        # MXTPU_GATEWAY_KV_JOURNAL_MB (kv_journal still caps the
+        # entry count; 0 for either disables the journal).
         cap = (kv_journal if kv_journal is not None
                else (32 if self.paged else 0))
         self._journal_cap = max(0, int(cap))
+        self._journal_max_bytes = max(0, env_int(
+            "MXTPU_GATEWAY_KV_JOURNAL_MB", 256,
+            "Total host-RAM byte budget (in MB) for the gateway's "
+            "seated-handoff KV journal; a single block larger than "
+            "the budget is not journaled at all.")) * (1 << 20)
+        self._journal_bytes = 0
         self._journal: "Dict[Tuple[int, ...], KVHandoff]" = {}
         self._m_journal_hits = telemetry.counter(
             "gateway_kv_journal_hits_total",
@@ -835,16 +847,29 @@ class DisaggBackend:
                 entry[0].on_done(rid, reason)
 
     # -- KV journal (paged re-dispatch) --------------------------------------
+    @staticmethod
+    def _handoff_nbytes(h: KVHandoff) -> int:
+        return int(np.asarray(h.k).nbytes) + int(np.asarray(h.v).nbytes)
+
     def _journal_put(self, prompt: np.ndarray,
                      handoff: KVHandoff) -> None:
-        if self._journal_cap <= 0:
+        if self._journal_cap <= 0 or self._journal_max_bytes <= 0:
             return
+        nb = self._handoff_nbytes(handoff)
+        if nb > self._journal_max_bytes:
+            return      # one block alone busts the budget: skip it
         key = tuple(int(t) for t in prompt)
         with self._lock:
-            self._journal.pop(key, None)     # refresh insertion order
+            old = self._journal.pop(key, None)  # refresh insert order
+            if old is not None:
+                self._journal_bytes -= self._handoff_nbytes(old)
             self._journal[key] = handoff
-            while len(self._journal) > self._journal_cap:
-                self._journal.pop(next(iter(self._journal)))
+            self._journal_bytes += nb
+            while self._journal and (
+                    len(self._journal) > self._journal_cap
+                    or self._journal_bytes > self._journal_max_bytes):
+                ev = self._journal.pop(next(iter(self._journal)))
+                self._journal_bytes -= self._handoff_nbytes(ev)
 
     def _journal_lookup(self, prompt: np.ndarray
                         ) -> Optional[KVHandoff]:
@@ -948,6 +973,7 @@ class DisaggBackend:
                         queued=n_pending, active=0, slots=0,
                         paged=self.paged,
                         kv_journal=len(self._journal),
+                        kv_journal_bytes=int(self._journal_bytes),
                         breaker=self.breaker.describe())])
 
     # -- supervisor surface (decode pool) ------------------------------------

@@ -129,6 +129,29 @@ def test_prefix_cache_longest_common_prefix_and_eviction():
     assert len(c) == 2 and a.free_pages == free0
 
 
+def test_prefix_cache_pin_and_skip_eviction():
+    """pin() freshens LRU order without counting a hit; evict_lru can
+    be told to skip one pinned entry (the admission planner's matched
+    prefix) and reports nothing-evictable when only that remains."""
+    a = PageAllocator(10)
+    c = PrefixCache(a)
+    p1 = a.alloc(1)
+    e1 = c.insert([1], 1, p1)
+    a.release(p1)
+    p2 = a.alloc(1)
+    e2 = c.insert([2], 1, p2)
+    a.release(p2)
+    c.pin(e1)                               # e2 becomes the LRU
+    assert e1.hits == 0                     # pin is not a hit
+    assert c.evict_lru() is True
+    got, _ = c.lookup([2, 99])
+    assert got is None                      # e2 was evicted, e1 kept
+    assert c.evict_lru(skip=e1) is False    # only the pinned one left
+    got, _ = c.lookup([1, 99])
+    assert got is e1
+    assert c.evict_lru() is True            # unpinned: evictable again
+
+
 # ---------------------------------------------------------------------------
 # kernel: paged gather == dense slot attention, bit for bit
 # ---------------------------------------------------------------------------
@@ -231,6 +254,124 @@ def test_paged_pool_exhaustion_backpressures_and_drains(cfg, params):
         assert [int(t) for t in out[rid]] == llama_refs.reference(
             cfg, params, p, m, seed=s, temperature=1.0)
     assert e.kv_cache_stats()["pages_used"] == 0   # fully drained
+
+
+@pytest.mark.slow   # ~8s; the warm-hit-under-exhaustion regression
+def test_warm_hit_under_pool_exhaustion_stays_safe(cfg, params):
+    """Regression: a warm admission planned while the pool is nearly
+    dry must NEVER evict its own matched prefix entry mid-plan (that
+    freed — or re-handed as 'fresh' — the very pages the plan was
+    about to share: dead-page retain killed the loop, a re-handed
+    page silently aliased two logical positions). The planner now
+    pins the entry's pages first; when even that cannot fit, it falls
+    back to a COLD plan where the entry is evictable — backpressure
+    or fallback, never a crash, tokens always bit-exact."""
+    shared = [7, 3, 9, 1, 5, 2, 8, 4, 6]    # 9 toks: 1 full page + 1
+    # 3 usable pages: req A's admission takes all of them (2 row
+    # pages + 1 registered boundary copy)
+    e = paged_engine(cfg, params, n_pages=4)
+    ra = e.submit(Request(prompt=shared, max_new_tokens=4,
+                          temperature=1.0, seed=0))
+    out = e.run()
+    assert [int(t) for t in out[ra]] == llama_refs.reference(
+        cfg, params, shared, 4, seed=0, temperature=1.0)
+    st = e.kv_cache_stats()
+    assert st["prefix_entries"] == 1        # A registered; 2 pages held
+    # warm request: matches the entry, but free pages (1) can't cover
+    # even the warm plan — the fallback evicts the entry and admits
+    # cold instead of corrupting the pool
+    p2 = shared + [77, 78]
+    rb = e.submit(Request(prompt=p2, max_new_tokens=5,
+                          temperature=1.0, seed=1))
+    got = [int(t) for t in e.run()[rb]]
+    assert got == llama_refs.reference(cfg, params, p2, 5, seed=1,
+                                       temperature=1.0)
+    assert e.kv_cache_stats()["prefix_entries"] == 1   # B re-registered
+
+
+@pytest.mark.slow   # ~13s (own bucket shapes); CI home: paged_kv_slow
+def test_trimmed_handoff_injects_at_bucket_shape(cfg, params):
+    """Regression: the page-granular wire trims handoff blocks to an
+    arbitrary page multiple of true_len; the paged inject must pad
+    back to the power-of-two bucket — one compiled inject program per
+    BUCKET, not per prompt length — and stay bit-exact through the
+    zero-padded (length-masked) tail."""
+    from mxtpu.serve.gateway.disagg import handoff_to_page_frames, \
+        pages_to_handoff
+
+    prompt, mnew, seed, ps = [61, 62, 63, 64, 65], 6, 3, 4
+    full = llama_refs.reference(cfg, params, prompt, mnew, seed=seed,
+                                temperature=1.0)
+    padded = np.zeros((1, 16), np.int32)    # bucket 16 (min_bucket 16)
+    padded[0, :len(prompt)] = prompt
+    tok, kb, vb, rng = llama.prefill_detached(
+        cfg, params, jnp.asarray(padded), np.int32(len(prompt)),
+        jax.random.PRNGKey(seed), np.float32(1.0),
+        np.int32(cfg.vocab_size), np.float32(1.0))
+    h = KVHandoff(k=np.asarray(kb), v=np.asarray(vb),
+                  true_len=len(prompt), token=full[0],
+                  rng=np.asarray(rng, np.uint32))
+    frames = handoff_to_page_frames(0, h, ps)
+    _, trimmed = pages_to_handoff(
+        frames[-1], {f[2]: (f[3], f[4]) for f in frames[:-1]})
+    assert trimmed.k.shape[2] == 8          # ceil(5/4)*4 — wire trim
+    e = paged_engine(cfg, params, page_size=ps, min_bucket=16)
+    assert e._inject_block_len(trimmed) == 16   # padded to the bucket
+    rid = e.submit_prefilled(trimmed, Request(
+        prompt=prompt, max_new_tokens=mnew, temperature=1.0,
+        seed=seed))
+    assert [int(t) for t in e.run()[rid]] == full
+    # every trimmed shape the wire can produce maps into the bucket
+    # set: the inject compile count is bounded like prefill's
+    lens = set()
+    for tl in range(1, e.max_len + 1):
+        blk = min(-(-tl // ps) * ps, e.max_len)
+        fh = KVHandoff(k=np.zeros((1, 1, blk, 1), np.float32),
+                       v=np.zeros((1, 1, blk, 1), np.float32),
+                       true_len=tl, token=0,
+                       rng=np.zeros(2, np.uint32))
+        b = e._inject_block_len(fh)
+        assert b >= blk and b % ps == 0
+        lens.add(b)
+    from mxtpu.serve.engine import bucket_for
+    possible = {bucket_for(n, e.min_bucket, e.max_len)
+                for n in range(1, e.max_len + 1)}
+    assert len(lens) <= len(possible)
+
+
+def test_kv_journal_byte_cap():
+    """The seated-handoff journal is bounded in BYTES, not just
+    entries: oldest entries fall off past the budget, and a single
+    block larger than the whole budget is never journaled."""
+    import threading
+    from mxtpu.serve.gateway.disagg import DisaggBackend
+
+    be = object.__new__(DisaggBackend)
+    be._lock = threading.Lock()
+    be._journal_cap = 8
+    be._journal = {}
+    be._journal_bytes = 0
+
+    def mk(n):
+        k = np.zeros((1, 1, n, 1), np.float32)
+        return KVHandoff(k=k, v=k.copy(), true_len=n, token=0,
+                         rng=np.zeros(2, np.uint32))
+
+    nb = DisaggBackend._handoff_nbytes(mk(4))
+    be._journal_max_bytes = 2 * nb          # exactly two blocks fit
+    be._journal_put(np.asarray([1], np.int32), mk(4))
+    be._journal_put(np.asarray([2], np.int32), mk(4))
+    assert len(be._journal) == 2 and be._journal_bytes == 2 * nb
+    be._journal_put(np.asarray([3], np.int32), mk(4))
+    assert len(be._journal) == 2 and be._journal_bytes == 2 * nb
+    assert be._journal_lookup(np.asarray([1, 9], np.int32)) is None
+    assert be._journal_lookup(np.asarray([3, 9], np.int32)) is not None
+    be._journal_put(np.asarray([4], np.int32), mk(64))  # over budget
+    assert be._journal_lookup(np.asarray([4, 9], np.int32)) is None
+    assert be._journal_bytes == 2 * nb
+    be._journal_cap = 1                     # entry cap still applies
+    be._journal_put(np.asarray([5], np.int32), mk(4))
+    assert len(be._journal) == 1 and be._journal_bytes == nb
 
 
 def test_paged_journaled_restore_resumes_stream(cfg, params):
