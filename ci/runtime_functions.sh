@@ -52,8 +52,12 @@ lockcheck_smoke() {
     # a FRESH process so the factory patch precedes all lock
     # construction; conftest fails the session on any acquisition
     # order contradicting itself or the static lock graph
+    # the speculative kill test drives the MULTI-token step path
+    # (_build_drafts -> _dispatch -> variable-advance _emit), whose
+    # lock choreography differs from plain stepping (ISSUE 19)
     MXTPU_ANALYSIS_LOCKCHECK=1 python -m pytest \
         tests/test_serve_chaos.py::test_replica_kill_poisson_stream_bit_identical \
+        tests/test_serve_chaos.py::test_replica_kill_mid_speculative_run_bit_identical \
         -x -q "$@"
 }
 
@@ -253,6 +257,84 @@ paged_kv_slow() {
     # markers to stay inside its budget, so this stage is their
     # dedicated CI home (ci_all's unittest_cpu_mesh also runs them)
     python -m pytest tests/test_paged_kv.py -x -q -m slow "$@"
+}
+
+spec_decode_slow() {
+    # the slow-marked speculative-decoding heavies (mixed-config
+    # bit-identity, adversarial drafter, accepted-count rng advance,
+    # journaled spec resume, spec over shared CoW pages) — tier-1
+    # keeps the drafter unit tests and skips slow markers, so this
+    # stage is their dedicated CI home (spec_smoke is the fast
+    # fresh-process gate)
+    python -m pytest tests/test_spec_decode.py -x -q -m slow "$@"
+}
+
+spec_smoke() {
+    # speculative decoding end to end on CPU (docs/serving.md
+    # §Speculative decoding): a shared-prefix burst through a paged
+    # engine with speculate_k>0 — greedy AND sampled streams must be
+    # bit-identical to per-request generate (the verify oracle's whole
+    # contract), the accepted-token rate must beat 1 token/slot-step
+    # (speculation actually firing, not just verifying), and the
+    # compile count must sit exactly one program over the paged
+    # baseline. The full matrix is tier-1 in tests/test_spec_decode.py;
+    # this stage proves it in a fresh process with no pytest fixtures.
+    python - << 'PYEOF'
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+import jax.numpy as jnp
+from dataclasses import replace
+from mxtpu.models import llama
+from mxtpu.serve import Request, ServeEngine
+
+cfg = replace(llama.CONFIGS["tiny"], dtype=jnp.float32, remat=False,
+              attn_impl="dense", max_seq_len=256)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+def ref(prompt, mnew, seed, temp):
+    out = llama.generate(cfg, params,
+                         jnp.asarray(prompt, jnp.int32)[None], mnew,
+                         temperature=temp, rng=jax.random.PRNGKey(seed))
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+# shared-prefix burst: the first two prompts extend [140, 141, 140]
+# with its OWN greedy continuation (teacher-forcing — the remaining
+# greedy stream is unchanged), so they plateau immediately and the
+# n-gram drafter proposes full budgets, AND they span >1 page with a
+# non-page-aligned shared prefix, so the second admission forks the
+# boundary page (copy_page must compile). Two sampled requests ride
+# along to exercise the rng-chain half of the oracle.
+warm = [140, 141, 140] + ref([140, 141, 140], 9, 0, 0.0)   # len 12
+eng = ServeEngine(cfg, params, max_slots=2, max_len=256, min_bucket=8,
+                  paged=True, page_size=8, speculate_k=4)
+reqs = [(warm, 64, 0, 0.0),
+        (warm, 64, 1, 0.0),
+        ([140, 141, 141], 48, 2, 0.0),
+        ([140, 141, 140, 99], 32, 3, 1.0),
+        ([140, 141, 141, 7], 32, 4, 0.9)]
+rids = [eng.submit(Request(prompt=p, max_new_tokens=m,
+                           temperature=t, seed=s))
+        for (p, m, s, t) in reqs]
+res = eng.run()
+for rid, (p, m, s, t) in zip(rids, reqs):
+    got = [int(x) for x in res[rid]]
+    assert got == ref(p, m, s, t), (rid, got, ref(p, m, s, t))
+st = eng.kv_cache_stats()
+total = sum(m for (_, m, _, _) in reqs)
+per_slot_step = total / eng.steps_run / 2          # 2 slots
+assert per_slot_step > 1.0, (total, eng.steps_run)
+assert st["spec_accepted"] > 0, st
+assert eng.compile_count == eng.n_buckets + 3, \
+    (eng.compile_count, eng.n_buckets)   # decode + copy_page + verify
+print(f"spec_smoke: OK ({len(reqs)} shared-prefix requests "
+      f"bit-identical to generate, {per_slot_step:.2f} accepted "
+      f"tok/slot-step, accept rate {st['spec_accept_rate']:.2f}, "
+      f"compile count {eng.compile_count} == buckets+3)")
+PYEOF
 }
 
 gateway_smoke() {
@@ -909,6 +991,8 @@ ci_all() {
     serve_smoke
     paged_kv_smoke
     paged_kv_slow
+    spec_smoke
+    spec_decode_slow
     gateway_smoke
     fleet_smoke
     chaos_serve
@@ -931,6 +1015,7 @@ ci_fast() {
     bench_smoke
     serve_smoke
     paged_kv_smoke
+    spec_smoke
     gateway_smoke
     fleet_smoke
     chaos_serve

@@ -48,9 +48,10 @@ __all__ = ["LlamaConfig", "init_params", "forward", "forward_hidden",
            "quantize_params_int8", "int8_sharding_rules",
            "sample_logits", "init_slot_cache", "slot_cache_specs",
            "prefill_slot", "decode_slots", "prefill_detached",
-           "inject_slot_kv", "paged_cache_specs", "init_paged_cache",
+           "prefill_detached_chunk", "inject_slot_kv",
+           "paged_cache_specs", "init_paged_cache",
            "decode_slots_paged", "prefill_slot_paged",
-           "inject_paged_kv", "copy_page"]
+           "inject_paged_kv", "copy_page", "decode_slots_spec"]
 
 
 @dataclass(frozen=True)
@@ -1241,6 +1242,51 @@ def prefill_detached(cfg: LlamaConfig, params, tokens, true_len, rng,
     return tok, k_block, v_block, rng
 
 
+def prefill_detached_chunk(cfg: LlamaConfig, params, chunk, cache,
+                           true_len, rng, temperature, top_k, top_p,
+                           mesh: Optional[Mesh] = None):
+    """One chunk of a STREAMED detached prefill: run ``chunk`` (1, cw)
+    — positions ``cache["pos"]`` .. ``pos+cw`` of the END-padded
+    prompt — through the cached stack and return this chunk's
+    just-computed K/V rows so the worker can ship their page frames
+    over the wire WHILE the next chunk computes. Iterating this over
+    the whole bucket is the same math as one :func:`prefill_detached`
+    call: each position's attention masks the same causal prefix of
+    the same bucket-sized cache, and the sampler splits the SAME
+    request key once — so the streamed handoff stays bit-identical to
+    the one-shot path (the disagg bit-identity gate covers it). One
+    compiled program per (chunk width, bucket) pair.
+
+    ``cache``: the (L, 1, n_kv_heads, bucket, hd) running buffers +
+    ``pos``, carried across chunk calls (zeros at pos 0). Returns
+    ``(tok (1,), k_chunk, v_chunk, new_rng, new_cache)`` with
+    k/v_chunk shaped (L, n_kv_heads, cw, hd). ``tok``/``new_rng`` are
+    meaningful only from the chunk containing position
+    ``true_len - 1`` — the worker keeps that chunk's and discards the
+    rest (later chunks sample from padding logits; harmless garbage,
+    never emitted)."""
+    b, cw = chunk.shape
+    true_len = jnp.asarray(true_len, jnp.int32)
+    # the last REAL position, local to this chunk (clamped: chunks
+    # before/after the one holding true_len-1 sample garbage)
+    li = jnp.clip(true_len - 1 - cache["pos"], 0, cw - 1)
+    logits, cache = _forward_cached(cfg, params, chunk, cache,
+                                    mesh=mesh, last_index=li)
+    rng, sub = jax.random.split(rng)
+    tok = sample_logits(sub, logits[:, 0], temperature=temperature,
+                        top_k=top_k, top_p=top_p)
+    pos0 = cache["pos"] - cw
+    k_chunk = lax.dynamic_slice_in_dim(cache["k"][:, 0], pos0, cw,
+                                       axis=2)
+    v_chunk = lax.dynamic_slice_in_dim(cache["v"][:, 0], pos0, cw,
+                                       axis=2)
+    if mesh is not None:
+        tok = _mcon(mesh, tok, None)
+        k_chunk = _mcon(mesh, k_chunk, None, None, None, None)
+        v_chunk = _mcon(mesh, v_chunk, None, None, None, None)
+    return tok, k_chunk, v_chunk, rng, cache
+
+
 def inject_slot_kv(cfg: LlamaConfig, k_block, v_block, true_len, slot,
                    token, rng, kv, sv, mesh: Optional[Mesh] = None):
     """Decode-side admission of a handed-off prefill: write the
@@ -1739,3 +1785,215 @@ def copy_page(kv, src, dst):
             out[n] = lax.dynamic_update_index_in_dim(a, page, dst,
                                                      axis=1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (ISSUE 19): one batched VERIFY forward over each
+# slot's current token plus its k drafted tokens against the paged
+# pool, with a bit-exact accept oracle — a drafted token is accepted
+# iff it is IDENTICAL to what the target rng chain would emit
+# (Leviathan et al. 2023, specialized to exact-match acceptance so the
+# served stream is bit-identical to per-request ``generate`` by
+# construction, not merely distribution-preserving). Drafting itself is
+# host-side (the engine's prompt/n-gram lookup, or a small draft model
+# later) — this file only holds the device half.
+# ---------------------------------------------------------------------------
+
+def _layer_slots_spec(cfg: LlamaConfig, cos, sin, qlen, phys, off,
+                      page_table, mesh, kvspec, x, lp, ck, cv,
+                      cks=None, cvs=None):
+    """One block of the SPECULATIVE paged decode: x (S, W, dim) holds
+    each slot's current token plus its drafted run (W = k + 1). Token
+    i of slot s scatters its K/V into pool page ``phys[s, i]`` at
+    offset ``off[s, i]`` (the host redirects out-of-budget positions
+    and inactive slots to scratch page 0), then attends its OWN causal
+    prefix ``[0, qlen[s, i])`` — the per-query length mask that keeps
+    every drafted position's logits exactly what a sequential decode
+    at that position would compute."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ _wq8(lp["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ _wq8(lp["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ _wq8(lp["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = q.transpose(0, 2, 1, 3)          # (S, h, W, hd)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    head_ax = (kvspec[1] if kvspec is not None and len(kvspec) > 1
+               else None)
+    q = _mcon(mesh, q, None, head_ax, None, None)
+    k = _mcon(mesh, k, None, head_ax, None, None)
+    v = _mcon(mesh, v, None, head_ax, None, None)
+
+    knew = k.transpose(0, 2, 1, 3)       # (S, W, kvh, hd)
+    vnew = v.transpose(0, 2, 1, 3)
+    if cks is not None:                  # int8 pool: quantize the write
+        kq, ksc = _q8_token(knew)
+        vq, vsc = _q8_token(vnew)
+        ck = ck.at[phys, :, off, :].set(kq)
+        cv = cv.at[phys, :, off, :].set(vq)
+        cks = cks.at[phys, :, off].set(ksc)
+        cvs = cvs.at[phys, :, off].set(vsc)
+        kf = _gather_slot_pages_batch(ck, cks, page_table, dt)
+        vf = _gather_slot_pages_batch(cv, cvs, page_table, dt)
+        o = slot_decode_attention(q, kf, vf, qlen)
+    else:
+        ck = ck.at[phys, :, off, :].set(knew.astype(ck.dtype))
+        cv = cv.at[phys, :, off, :].set(vnew.astype(cv.dtype))
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            ck = lax.with_sharding_constraint(
+                ck, NamedSharding(mesh, kvspec))
+            cv = lax.with_sharding_constraint(
+                cv, NamedSharding(mesh, kvspec))
+        o = paged_decode_attention(q, ck, cv, page_table, qlen)
+
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    x = x + _mcon(mesh, o @ _wq8(lp["wo"], dt), None, None, None)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    delta, _ = _ffn(cfg, lp, h, mesh, serving=True)
+    x = x + _mcon(mesh, delta, None, None, None)
+    if cks is not None:
+        return x, ck, cv, cks, cvs
+    return x, ck, cv
+
+
+def decode_slots_spec(cfg: LlamaConfig, params, kv, sv, active,
+                      page_table, drafts, temperature, top_k, top_p,
+                      mesh: Optional[Mesh] = None):
+    """ONE speculative decode step over the PAGED bank: feed each
+    slot's current token plus its ``k`` drafted tokens (W = k + 1
+    positions) through a single batched target forward, then run the
+    exact-match accept oracle down each slot's rng chain.
+
+    Emission i+1 of a slot is ``sample_logits`` of the logits after
+    position pos+i, drawn with the SAME split-discipline as
+    :func:`decode_slots_paged` (one ``jax.random.split`` per VALID
+    emission — rejected positions never advance the chain, so
+    ``serve.resume_key(seed, n_emitted)`` stays exact under
+    multi-token emission). Emission i+1 is valid iff every earlier
+    draft matched its emission exactly; the number of valid emissions
+    per step is therefore 1..W (the plain decode emission always
+    lands). Rejected-suffix KV is "rolled back" by simply not
+    advancing ``lengths`` past the accepted run: the garbage K/V
+    beyond the new length is excluded by every later length mask and
+    overwritten in place by the next step's writes — no page is ever
+    freed or re-granted mid-run (page refcounts are the host's and
+    never change here).
+
+    drafts: (S, k) int32, entry < 0 = no draft at that position (a
+    draftless slot emits exactly 1 token, bit-matching the plain
+    step). page_table as in :func:`decode_slots_paged` — inactive
+    slots carry zeroed rows so all their writes land in scratch page
+    0; writes past the table's capacity are redirected to scratch
+    rather than clamped (a clamp would corrupt the slot's last live
+    page). Returns (toks (S, W) int32, emits (S, W) bool, new kv,
+    new sv): the engine emits ``toks[s, :emits[s].sum()]``."""
+    int8 = "ks" in kv
+    ps = kv["k"].shape[3]
+    cap = page_table.shape[1] * ps
+    S, K = drafts.shape
+    W = K + 1
+    lengths = sv["lengths"].astype(jnp.int32)
+    pos = jnp.minimum(lengths, cap - 1)
+    wpos = pos[:, None] + jnp.arange(W)[None, :]      # (S, W)
+    oob = wpos >= cap
+    cw = jnp.minimum(wpos, cap - 1)                   # safe gather idx
+    rows = jnp.arange(S)[:, None]
+    phys = jnp.where(oob, 0, page_table[rows, cw // ps])
+    off = cw % ps
+    qlen = wpos + 1                       # query i attends [0, pos+i+1)
+
+    toks_in = jnp.concatenate(
+        [sv["tokens"][:, None], drafts.astype(sv["tokens"].dtype)],
+        axis=1)
+    emb = params["tok_embed"]
+    if isinstance(emb, dict):
+        x = emb["q8"][toks_in].astype(cfg.dtype) * \
+            emb["s8"][0].astype(cfg.dtype)
+    else:
+        x = emb[toks_in].astype(cfg.dtype)
+
+    kvspec = None
+    if mesh is not None:
+        kvspec = P(*tuple(paged_cache_specs(cfg, mesh)["k"])[1:])
+    cos_t, sin_t = rope_tables(cfg, cap)
+    cos = cos_t[cw][:, None]              # (S, 1, W, hd/2)
+    sin = sin_t[cw][:, None]
+
+    if int8:
+        def body(x, xs):
+            lp, ck, cv, cks, cvs = xs
+            x, ck, cv, cks, cvs = _layer_slots_spec(
+                cfg, cos, sin, qlen, phys, off, page_table, mesh,
+                kvspec, x, lp, ck, cv, cks, cvs)
+            return x, (ck, cv, cks, cvs)
+        x, (ck, cv, cks, cvs) = lax.scan(
+            body, x, (params["layers"], kv["k"], kv["v"],
+                      kv["ks"], kv["vs"]))
+        new_kv = {"k": ck, "v": cv, "ks": cks, "vs": cvs}
+    else:
+        def body(x, xs):
+            lp, ck, cv = xs
+            x, ck, cv = _layer_slots_spec(
+                cfg, cos, sin, qlen, phys, off, page_table, mesh,
+                kvspec, x, lp, ck, cv)
+            return x, (ck, cv)
+        x, (ck, cv) = lax.scan(body, x,
+                               (params["layers"], kv["k"], kv["v"]))
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            full = NamedSharding(mesh, paged_cache_specs(cfg, mesh)["k"])
+            ck = lax.with_sharding_constraint(ck, full)
+            cv = lax.with_sharding_constraint(cv, full)
+        new_kv = {"k": ck, "v": cv}
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    hw = (_wq8(params["tok_embed"], cfg.dtype).T if cfg.tie_embeddings
+          else _wq8(params["lm_head"], cfg.dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, hw,
+                        preferred_element_type=jnp.float32)   # (S, W, V)
+
+    # accept oracle: scan the W per-position logits down the slot's rng
+    # chain. ok carries "all earlier drafts matched"; the key advances
+    # ONLY on a valid emission (exactly one split per emitted token).
+    nxt = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.full((S, 1), -1, jnp.int32)],
+        axis=1)                           # draft verified by emission i
+    has = nxt >= 0
+
+    def one(key, lgs, nx, hs, t, kk, pp):
+        def step(carry, inp):
+            key, ok = carry
+            lg, nd, h = inp
+            key2, sub = jax.random.split(key)
+            tok = sample_logits(sub, lg[None], temperature=t,
+                                top_k=kk, top_p=pp)[0]
+            emit = ok
+            key = jnp.where(emit, key2, key)
+            ok = ok & h & (tok == nd)
+            return (key, ok), (tok, emit)
+        (key, _), (tk, em) = lax.scan(
+            step, (key, jnp.bool_(True)), (lgs, nx, hs))
+        return key, tk, em
+
+    new_rngs, toks, emits = jax.vmap(one)(
+        sv["rngs"], logits, nxt, has, temperature, top_k, top_p)
+    # dtype pinned: under x64 a default integer sum promotes to int64,
+    # which would flip the lengths dtype and retrace every program
+    n_emit = jnp.sum(emits, axis=1, dtype=jnp.int32)  # (S,) in 1..W
+    new_lengths = lengths + n_emit * active.astype(jnp.int32)
+    last = jnp.take_along_axis(
+        toks, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    if mesh is not None:
+        toks = _mcon(mesh, toks, None, None)
+        emits = _mcon(mesh, emits, None, None)
+        last = _mcon(mesh, last, None)
+        new_lengths = _mcon(mesh, new_lengths, None)
+        new_rngs = _mcon(mesh, new_rngs, None, None)
+    return toks, emits, new_kv, \
+        {"lengths": new_lengths, "tokens": last, "rngs": new_rngs}

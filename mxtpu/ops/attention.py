@@ -204,7 +204,12 @@ def slot_decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     k, v: (slots, n_kv_heads, max_len, hd) — the per-layer slot cache
     (GQA: ``n_heads % n_kv_heads == 0``; queries are grouped per kv
     head, the cache is never repeated).
-    lengths: (slots,) int — slot i attends keys ``[0, lengths[i])``.
+    lengths: (slots,) int — slot i attends keys ``[0, lengths[i])`` —
+    or (slots, s) int for PER-QUERY lengths: query j of slot i attends
+    ``[0, lengths[i, j])``. The 2-D form is the speculative verify
+    step's causal mask (query j sees the prefix plus the j drafted
+    tokens before it) and reduces to the 1-D form at s == 1, so the
+    decode fast path is unchanged.
 
     Blockwise flash-style online softmax over ``kv_block``-wide KV
     slices: the (s, max_len) score matrix is never materialized — only
@@ -242,8 +247,12 @@ def slot_decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
         scores = jnp.einsum("bgrsd,bgkd->bgrsk", qg, kblk,
                             preferred_element_type=jnp.float32) * scale
         kpos = i * kv_block + jnp.arange(kv_block)       # (kv_block,)
-        allowed = kpos[None, :] < lengths[:, None]       # (b, kv_block)
-        allowed = allowed[:, None, None, None, :]
+        if lengths.ndim == 2:   # per-query: (b, sq, kv_block)
+            allowed = kpos[None, None, :] < lengths[:, :, None]
+            allowed = allowed[:, None, None, :, :]
+        else:
+            allowed = kpos[None, :] < lengths[:, None]   # (b, kv_block)
+            allowed = allowed[:, None, None, None, :]
         scores = jnp.where(allowed, scores, _NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         corr = jnp.exp(m - m_new)
@@ -280,7 +289,9 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     page_table: (slots, pages_per_slot) int32 — slot i's logical page j
     lives at pool index ``page_table[i, j]``.
     lengths: (slots,) int — slot i attends positions ``[0, lengths[i])``
-    of its gathered sequence.
+    of its gathered sequence — or (slots, s) for per-query lengths,
+    passed straight through to the slot kernel (the speculative
+    verify step's mask).
     """
     if q.shape[0] != page_table.shape[0]:
         raise ValueError(

@@ -54,7 +54,8 @@ from ..telemetry import distributed as dtrace
 from ..models import llama
 
 __all__ = ["Request", "KVHandoff", "ServeEngine", "bucket_for",
-           "resume_key", "PageAllocator", "PrefixCache"]
+           "resume_key", "PageAllocator", "PrefixCache",
+           "ngram_drafter"]
 
 # admission wait is measured in engine steps (arrival → slot grant)
 _WAIT_STEP_BUCKETS = (0.0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 1024)
@@ -124,6 +125,21 @@ def _engine_metrics(eid: str):
         "cow": telemetry.counter(
             "serve_cow_forks_total",
             "Copy-on-write page forks (private copy of a shared page)"),
+        # speculative decoding (ISSUE 19): draft/accept accounting —
+        # the accept RATE is the whole ballgame (a rejected draft costs
+        # a wasted verify position), so both ends are counted
+        "spec_proposed": telemetry.counter(
+            "serve_spec_proposed_total",
+            "Drafted tokens proposed to the speculative verify step"),
+        "spec_accepted": telemetry.counter(
+            "serve_spec_accepted_total",
+            "Drafted tokens accepted (bit-exact match with the "
+            "target chain)"),
+        "spec_len": telemetry.histogram(
+            "serve_spec_accepted_len",
+            "Tokens emitted per slot per speculative step (1 + "
+            "accepted run length)",
+            buckets=(0.0, 1, 2, 3, 4, 6, 8, 12, 16)),
     }
 
 
@@ -388,6 +404,36 @@ def resume_key(seed: int, n_emitted: int) -> np.ndarray:
     return np.asarray(key, np.uint32)
 
 
+def ngram_drafter(history: np.ndarray, k: int) -> np.ndarray:
+    """The default model-free drafter: propose the ``k`` tokens that
+    followed the most recent earlier occurrence of the history's
+    longest trailing n-gram (g = 3, 2, 1 — prompt/self-repetition
+    lookup, cf. "prompt lookup decoding"). A match at position ``i``
+    implies the stream repeats with period ``(n - g) - i``, so when
+    fewer than ``k`` tokens literally follow the match the draft is
+    extended cyclically — a plateau (period 1) drafts the full budget
+    instead of a single token. Deterministic pure host arithmetic:
+    drafting never touches the rng chain, the device, or any
+    cross-request state, so speculative runs stay bit-identical and
+    re-dispatch-safe no matter what this returns. Returns up to ``k``
+    int32 tokens (possibly none — a draftless step emits one token
+    exactly like the plain path)."""
+    h = np.asarray(history, np.int64).reshape(-1)
+    n = int(h.size)
+    if k < 1 or n < 2:
+        return np.empty(0, np.int32)
+    for g in (3, 2, 1):
+        if n <= g:
+            continue
+        tail = h[n - g:]
+        for i in range(n - g - 1, -1, -1):
+            if np.array_equal(h[i:i + g], tail):
+                period = (n - g) - i
+                out = h[[i + g + (j % period) for j in range(k)]]
+                return out.astype(np.int32)
+    return np.empty(0, np.int32)
+
+
 @dataclass
 class KVHandoff:
     """A prefill worker's detached output — everything a decode engine
@@ -407,10 +453,14 @@ class KVHandoff:
 class _Dispatch:
     """One in-flight decode step: the device handle plus the host-side
     snapshot needed to attribute its tokens after the overlapped
-    sync."""
+    sync. A speculative step carries (S, W) token/valid matrices in
+    ``sampled``/``emits`` instead of the plain (S,) tokens, plus the
+    per-slot proposed-draft counts for the accept-rate accounting."""
     sampled: Any                                   # device (S,) int32
     slots: List[Tuple[int, int]]                   # (slot, rid) active
     firsts: List[Tuple[int, Any]]                  # (rid, device (1,))
+    emits: Any = None                              # spec: device (S, W)
+    proposed: Optional[np.ndarray] = None          # spec: (S,) host
 
 
 class ServeEngine:
@@ -433,7 +483,9 @@ class ServeEngine:
                  page_size: Optional[int] = None,
                  n_pages: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 int8_pages: Optional[bool] = None):
+                 int8_pages: Optional[bool] = None,
+                 speculate_k: Optional[int] = None,
+                 drafter: Optional[Callable] = None):
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
@@ -484,6 +536,30 @@ class ServeEngine:
             self.prefix_cache_enabled = False
             self.int8_pages = False
 
+        # speculative decoding (ISSUE 19): draft k tokens host-side
+        # per slot per step, verify them in ONE batched forward, and
+        # advance each slot by its accepted run length. Paged-only:
+        # the verify program scatters through the page-table
+        # indirection (decode_slots_spec).
+        self.speculate_k = int(
+            speculate_k if speculate_k is not None
+            else _env_int("MXTPU_SERVE_SPECULATE_K", 0))
+        if self.speculate_k < 0:
+            raise ValueError(
+                f"speculate_k must be >= 0, got {self.speculate_k}")
+        if self.speculate_k and not self.paged:
+            raise ValueError(
+                "speculate_k requires paged=True (the verify program "
+                "runs against the paged KV layout)")
+        self._drafter = drafter or ngram_drafter
+        if self.speculate_k:
+            # the host drafter conditions on every token emitted so
+            # far, so the previous step's tokens must be read back
+            # BEFORE the next step is drafted — speculative mode is
+            # inherently synchronous, and its sync cost is amortized
+            # over the whole accepted run rather than one token
+            self.overlap = False
+
         if self.paged:
             state = llama.init_paged_cache(
                 cfg, self.max_slots, self.n_pages, self.page_size,
@@ -509,6 +585,16 @@ class ServeEngine:
             "serve_decode", expected=1, loop="serve")
         self._prefills: Dict[int, Any] = {}
         self._injects: Dict[int, Any] = {}
+        self._spec_decode = None
+        if self.speculate_k:
+            # the ONE extra watched program speculative mode adds (the
+            # k-verify step) — compile_count's bound grows by exactly
+            # this; steps where no slot has a draft still run the
+            # plain decode program (mixed stepping, same bank)
+            self._spec_decode = telemetry.watch(
+                jax.jit(partial(llama.decode_slots_spec, cfg,
+                                mesh=mesh), donate_argnums=(1,)),
+                "serve_spec_verify", expected=1, loop="serve")
         if self.paged:
             # host page-table (a small int32 operand per step), the
             # refcounted allocator, the prefix cache, and the CoW
@@ -552,6 +638,13 @@ class ServeEngine:
         self._topks = np.full(S, cfg.vocab_size, np.int32)
         self._topps = np.ones(S, np.float32)
         self._slot_rid: List[Optional[int]] = [None] * S
+        # speculative mode: per-slot token history (prompt + every
+        # emitted token) the host drafter conditions on, plus the
+        # engine-local draft/accept tallies (all written under _lock)
+        self._hist: List[List[int]] = [[] for _ in range(S)]
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_steps = 0
 
         # KV occupancy accounting: host-mirrored per-slot lengths (a
         # prefill seats the prompt length; every decode dispatch adds
@@ -1160,13 +1253,71 @@ class ServeEngine:
                              else req.top_k)
         self._topps[slot] = 1.0 if req.top_p is None else req.top_p
         self._slot_rid[slot] = rid
+        if self.speculate_k:
+            # drafting context: the prompt now, every emission later
+            # (a journaled-resume prompt already carries the tokens
+            # emitted before the crash — exactly the right context)
+            self._hist[slot] = [
+                int(t) for t in
+                np.asarray(req.prompt, np.int32).reshape(-1)]
 
     # -- stepping ------------------------------------------------------------
+    def _build_drafts(self) -> Optional[np.ndarray]:
+        """Host drafting for one speculative step: up to
+        ``speculate_k`` tokens per active slot from the pluggable
+        drafter, clamped to ``max_new_tokens - emitted - 1`` so every
+        accepted write stays inside the slot's granted pages (the
+        admission plan covers prompt + max_new_tokens, and the last
+        emitted token's KV is never written). Returns (S, k) int32
+        with -1 marking no-draft, or None when NO slot drafted — the
+        loop then runs the plain decode program (mixed stepping)."""
+        K = self.speculate_k
+        drafts = np.full((self.max_slots, K), -1, np.int32)
+        any_d = False
+        with self._lock:
+            for s, rid in enumerate(self._slot_rid):
+                if rid is None or not self._active[s]:
+                    continue
+                req = self._requests.get(rid)
+                res = self._results.get(rid)
+                if req is None or res is None \
+                        or self._done.get(rid, True) \
+                        or rid in self._cancelled:
+                    continue
+                hist = self._hist[s]
+                # steady-state invariant: hist ends with the pending
+                # token w0 (device length + 1 entries). A slot
+                # admitted THIS step has its first token still
+                # device-side — it drafts nothing this once
+                if len(hist) <= int(self._slot_len[s]):
+                    continue
+                budget = min(K, int(req.max_new_tokens) - len(res) - 1)
+                if budget < 1:
+                    continue
+                d = np.asarray(
+                    self._drafter(np.asarray(hist, np.int32), budget),
+                    np.int32).reshape(-1)[:budget]
+                if d.size:
+                    drafts[s, :d.size] = d
+                    any_d = True
+        return drafts if any_d else None
+
     def _dispatch(self, firsts) -> _Dispatch:
         # host DISPATCH time only — the program runs async; device time
         # belongs to the XLA trace (no sync in the decode loop, MXL004)
+        drafts = self._build_drafts() if self.speculate_k else None
+        emits = proposed = None
         with self._span_decode():
-            if self.paged:
+            if drafts is not None:
+                # the k-verify step: one batched forward over each
+                # slot's current token + drafts, accept-by-identity
+                # down the same rng chain (decode_slots_spec)
+                sampled, emits, self._kv, self._sv = self._spec_decode(
+                    self.params, self._kv, self._sv, self._active,
+                    self._pt, drafts, self._temps, self._topks,
+                    self._topps)
+                proposed = (drafts >= 0).sum(axis=1).astype(np.int64)
+            elif self.paged:
                 # the page table rides as a small int32 operand —
                 # table edits at admission never touch device state
                 # or the jit cache key
@@ -1180,13 +1331,20 @@ class ServeEngine:
         self._m["steps"].inc()
         with self._lock:
             self.steps_run += 1
+            if drafts is not None:
+                self._spec_steps += 1
             slots = [(s, rid) for s, rid in enumerate(self._slot_rid)
                      if self._active[s] and rid is not None]
-            # the decode program appends one cache entry per active
-            # slot; mirror that on the host (no readback — MXL004)
-            for s, _rid in slots:
-                self._slot_len[s] += 1
-        return _Dispatch(sampled, slots, firsts)
+            if emits is None:
+                # the decode program appends one cache entry per
+                # active slot; mirror that on the host (no readback —
+                # MXL004). A speculative step advances by the accepted
+                # run, known only after the sync — _process (always
+                # synchronous in spec mode) mirrors it there
+                for s, _rid in slots:
+                    self._slot_len[s] += 1
+        return _Dispatch(sampled, slots, firsts, emits=emits,
+                         proposed=proposed)
 
     def _emit(self, rid: int, token: int, now: float) -> None:
         self._results[rid].append(token)
@@ -1207,18 +1365,54 @@ class ServeEngine:
         # the device sync happens OUTSIDE the lock — a submitter must
         # never block behind a device readback
         sampled = np.asarray(disp.sampled) if disp.slots else None
+        emits = (np.asarray(disp.emits)
+                 if disp.emits is not None and disp.slots else None)
         now = time.perf_counter()
         with self._lock:
+            rid2slot = ({rid: s for s, rid in
+                         enumerate(self._slot_rid) if rid is not None}
+                        if self.speculate_k else {})
             for rid, dev in disp.firsts:
                 if rid not in self._cancelled:
-                    self._emit(rid, int(np.asarray(dev)[0]), now)
+                    tok = int(np.asarray(dev)[0])
+                    self._emit(rid, tok, now)
+                    s = rid2slot.get(rid)
+                    if s is not None:
+                        self._hist[s].append(tok)
             if disp.slots:
                 for slot, rid in disp.slots:
-                    # a pruned rid (non-retained, finalized) reads as
-                    # done — never emit for it
-                    if not self._done.get(rid, True) \
+                    if emits is not None:
+                        # speculative step: the device advanced this
+                        # slot by its accepted run — mirror the length
+                        # and emit the run in order (the emission loop
+                        # stops at max_new_tokens/cancel; the device's
+                        # over-advance on a finishing slot is inert —
+                        # the slot is freed below and reseeded at its
+                        # next admission)
+                        n = int(emits[slot].sum())
+                        self._slot_len[slot] += n
+                        prop = int(disp.proposed[slot])
+                        self._spec_proposed += prop
+                        self._spec_accepted += n - 1
+                        if prop:
+                            self._m["spec_proposed"].inc(prop)
+                            self._m["spec_accepted"].inc(n - 1)
+                        self._m["spec_len"].observe(n)
+                        for i in range(n):
+                            # a pruned rid (non-retained, finalized)
+                            # reads as done — never emit for it
+                            if self._done.get(rid, True) \
+                                    or rid in self._cancelled:
+                                break
+                            tok = int(sampled[slot, i])
+                            self._emit(rid, tok, now)
+                            self._hist[slot].append(tok)
+                    elif not self._done.get(rid, True) \
                             and rid not in self._cancelled:
-                        self._emit(rid, int(sampled[slot]), now)
+                        tok = int(sampled[slot])
+                        self._emit(rid, tok, now)
+                        if self.speculate_k:
+                            self._hist[slot].append(tok)
             for slot, rid in enumerate(self._slot_rid):
                 if rid is None:
                     continue
@@ -1356,6 +1550,10 @@ class ServeEngine:
             # the CoW fork/registration copy is ONE program (src/dst
             # are traced scalars) — the paged bound is buckets + 2
             fns.append(self._copy_fn)
+        if self._spec_decode is not None:
+            # speculative mode adds exactly ONE watched program (the
+            # k-verify step) — the spec bound is buckets + 3
+            fns.append(self._spec_decode)
         return int(sum(f._cache_size() for f in fns))
 
     @property
@@ -1395,6 +1593,17 @@ class ServeEngine:
                                      if self._prefix is not None
                                      else []),
                 })
+                if self.speculate_k:
+                    prop = self._spec_proposed
+                    out.update({
+                        "speculate_k": self.speculate_k,
+                        "spec_proposed": prop,
+                        "spec_accepted": self._spec_accepted,
+                        "spec_accept_rate": (
+                            self._spec_accepted / prop if prop
+                            else 0.0),
+                        "spec_steps": self._spec_steps,
+                    })
         live = live_tokens * self._kv_tok_bytes
         out["live_bytes"] = live
         out["occupancy"] = (live / self._kv_reserved
@@ -1417,8 +1626,12 @@ class ServeEngine:
 
     def reset_stats(self) -> None:
         """Zero the per-engine latency histogram + step counter (the
-        bench warmup boundary)."""
+        bench warmup boundary). Speculative accept counters reset with
+        it so a bench's accept rate excludes warmup traffic."""
         with self._lock:      # _emit observes/updates these under _lock
             self._lat.reset()
             self._last_tok.clear()
             self.steps_run = 0
+            self._spec_proposed = 0
+            self._spec_accepted = 0
+            self._spec_steps = 0

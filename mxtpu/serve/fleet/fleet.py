@@ -254,7 +254,9 @@ class FleetGateway:
                 "fleet_session_affinity_total",
                 "Session-affinity lookups at the fleet router: hit = "
                 "routed to the remembered warm replica, miss = first "
-                "sight or the replica is gone", result=result)
+                "sight or the replica is gone, prefix = the session "
+                "map missed but prefix-page affinity found a replica "
+                "already holding the prompt's pages", result=result)
         m.inc()
 
     def _affinity_get(self, model: str,
@@ -292,10 +294,20 @@ class FleetGateway:
         applies there."""
         entry = self._entry(model)
         session = None if session_id is None else str(session_id)
-        prefer = self._affinity_get(entry.spec.name, session)
+        sess_prefer = self._affinity_get(entry.spec.name, session)
+        prefer = sess_prefer
+        if prefer is None:
+            # session map missed (stale entry, evicted, or no
+            # session_id at all): fall back to prefix-page affinity —
+            # the per-model gateway knows which replica's paged cache
+            # already holds this prompt's head, so a returning
+            # conversation still lands on its warm pages
+            prefer = entry.gateway.prefix_prefer(prompt)
+            if prefer is not None:
+                self._count_aff("prefix")
         handle = entry.gateway.submit(
             prompt, max_new_tokens, prefer_replica=prefer, **kw)
-        self._affinity_record(entry.spec.name, session, prefer,
+        self._affinity_record(entry.spec.name, session, sess_prefer,
                               handle)
         return handle
 
@@ -311,10 +323,17 @@ class FleetGateway:
         entry = self._entry(None if model is None else str(model))
         session = body.get("session_id")
         session = None if session is None else str(session)
-        prefer = self._affinity_get(entry.spec.name, session)
+        sess_prefer = self._affinity_get(entry.spec.name, session)
+        prefer = sess_prefer
+        if prefer is None and body.get("prompt") is not None:
+            # same prefix-page fallback as submit(): a session-map
+            # miss still routes to the replica holding warm pages
+            prefer = entry.gateway.prefix_prefer(body["prompt"])
+            if prefer is not None:
+                self._count_aff("prefix")
         handle = entry.gateway.submit_dict(body, trace_id=trace_id,
                                            prefer_replica=prefer)
-        self._affinity_record(entry.spec.name, session, prefer,
+        self._affinity_record(entry.spec.name, session, sess_prefer,
                               handle)
         return handle
 

@@ -428,6 +428,71 @@ def pages_to_handoff(done: tuple,
                                rng=np.asarray(rng, np.uint32))
 
 
+class _PageBuffer:
+    """Feeder-side INCREMENTAL reassembly of a page-granular handoff:
+    each kvpage frame is copied into a growing host block on arrival
+    (idempotent by page index — a resent frame overwrites itself in
+    place), so by the time the closing kvdone lands the block is
+    already assembled and the kvdone → seat path does no
+    concatenation work. With a streaming worker those copies overlap
+    prefill compute; with the one-shot worker the behavior is
+    unchanged except the assembly moving off the seat path. Frames
+    ride an ordered acked channel, so the first frame's width IS the
+    page size (only the last page may be short)."""
+
+    __slots__ = ("k", "v", "have", "ps")
+
+    def __init__(self):
+        self.k: Optional[np.ndarray] = None
+        self.v: Optional[np.ndarray] = None
+        self.have: Dict[int, int] = {}      # page idx -> width
+        self.ps = 0
+
+    def add(self, idx: int, kc, vc) -> None:
+        kc, vc = np.asarray(kc), np.asarray(vc)
+        idx, w = int(idx), int(kc.shape[2])
+        if self.ps == 0:
+            self.ps = w
+        elif w > self.ps:
+            raise rpc.RPCProtocolError(
+                f"kvpage width {w} exceeds page size {self.ps}")
+        need = idx * self.ps + w
+        if self.k is None or self.k.shape[2] < need:
+            cap = max(need, 2 * (self.k.shape[2]
+                                 if self.k is not None else 0))
+            nk = np.zeros(kc.shape[:2] + (cap,) + kc.shape[3:],
+                          kc.dtype)
+            nv = np.zeros_like(nk)
+            if self.k is not None:
+                nk[:, :, :self.k.shape[2]] = self.k
+                nv[:, :, :self.v.shape[2]] = self.v
+            self.k, self.v = nk, nv
+        off = idx * self.ps
+        self.k[:, :, off:off + w] = kc
+        self.v[:, :, off:off + w] = vc
+        self.have[idx] = w
+
+    def finish(self, done: tuple) -> Tuple[int, KVHandoff]:
+        """Close out on the kvdone frame — same contract as
+        :func:`pages_to_handoff`, minus the concatenation."""
+        if not (isinstance(done, tuple) and len(done) == 6
+                and done[0] == "kvdone"):
+            raise rpc.RPCProtocolError(
+                f"not a kvdone frame: {str(done)[:80]}")
+        _, rid, true_len, token, rng, n_chunks = done
+        n_chunks = int(n_chunks)
+        missing = [i for i in range(n_chunks) if i not in self.have]
+        if missing or n_chunks < 1:
+            raise rpc.RPCProtocolError(
+                f"kv handoff rid={rid} missing page chunks "
+                f"{missing[:8]}")
+        n = (n_chunks - 1) * self.ps + self.have[n_chunks - 1]
+        return int(rid), KVHandoff(
+            k=self.k[:, :, :n], v=self.v[:, :, :n],
+            true_len=int(true_len), token=int(token),
+            rng=np.asarray(rng, np.uint32))
+
+
 class CircuitBreaker:
     """Consecutive-failure breaker over the prefill path. closed →
     normal routing; ``threshold`` consecutive failures → OPEN
@@ -539,7 +604,8 @@ class PrefillWorker:
                  name: str = "p0",
                  on_fail: Optional[Callable[[int, str],
                                             None]] = None,
-                 wire_page_size: Optional[int] = None):
+                 wire_page_size: Optional[int] = None,
+                 stream_chunk: Optional[int] = None):
         self.cfg = cfg
         self.params = params
         self.channel = channel
@@ -549,12 +615,37 @@ class PrefillWorker:
         # one acked frame per KV page, trimmed to the pages true_len
         # covers — bucket padding never crosses the wire
         self.wire_page_size = wire_page_size
+        # streamed prefill pages: compute the prompt in fixed-width
+        # chunks and ship each page's frame AS IT FILLS, so wire
+        # transfer + feeder staging overlap prefill compute instead of
+        # trailing it (TTFT). Rounded up to a power of two so every
+        # chunk divides every bucket (one compiled chunk program per
+        # bucket); requires a power-of-two wire page so page frames
+        # align with chunk boundaries — anything else falls back to
+        # the one-shot path silently.
+        sc = (stream_chunk if stream_chunk is not None else env_int(
+            "MXTPU_DISAGG_STREAM_CHUNK", 0,
+            "Chunk width (tokens) for streamed detached prefill: the "
+            "prefill worker runs the prompt in chunks of this many "
+            "tokens and ships each KV page's wire frame as its page "
+            "fills, overlapping handoff transfer with compute "
+            "(rounded up to a power of two >= the wire page size); "
+            "0 keeps the one-shot prefill, where every page ships at "
+            "completion."))
+        self.stream_chunk = 0
+        if sc and wire_page_size and not (int(wire_page_size)
+                                          & (int(wire_page_size) - 1)):
+            cw = 1
+            while cw < max(int(sc), int(wire_page_size)):
+                cw *= 2
+            self.stream_chunk = cw
         self.mesh = mesh
         self.name = name
         self.on_fail = on_fail
         self.stopping = False
         self.failure: Optional[BaseException] = None
         self._fns: Dict[int, Any] = {}
+        self._cfns: Dict[int, Any] = {}
         self._jobs: "queue.Queue[Any]" = queue.Queue()
         self._cur_lock = threading.Lock()
         self._current: Optional[Tuple[int, Request]] = None
@@ -599,7 +690,9 @@ class PrefillWorker:
 
     @property
     def compile_count(self) -> int:
-        return int(sum(f._cache_size() for f in self._fns.values()))
+        return int(sum(f._cache_size() for f in self._fns.values())
+                   + sum(f._cache_size()
+                         for f in self._cfns.values()))
 
     def _fn(self, bucket: int):
         fn = self._fns.get(bucket)
@@ -609,6 +702,21 @@ class PrefillWorker:
                                 mesh=self.mesh)),
                 f"gateway_prefill_b{bucket}", expected=1)
             self._fns[bucket] = fn
+        return fn
+
+    def _chunk_fn(self, bucket: int):
+        """The streamed-prefill chunk program for one bucket (chunk
+        width is fixed per worker, so this is one compile per bucket
+        — the same growth rate as the one-shot prefill). The running
+        cache is donated: chunk c+1 reuses chunk c's buffers."""
+        fn = self._cfns.get(bucket)
+        if fn is None:
+            fn = telemetry.watch(
+                jax.jit(partial(llama.prefill_detached_chunk,
+                                self.cfg, mesh=self.mesh),
+                        donate_argnums=(2,)),
+                f"gateway_prefill_stream_b{bucket}", expected=1)
+            self._cfns[bucket] = fn
         return fn
 
     def _run(self) -> None:
@@ -656,6 +764,13 @@ class PrefillWorker:
             key = (jax.random.PRNGKey(req.seed) if req.rng is None  # noqa: MXL301 — chain position 0 is PRNGKey(seed); the rng branch is a mid-chain resume key
                    else jax.numpy.asarray(np.asarray(req.rng,
                                                      np.uint32)))
+            if self.stream_chunk and self.wire_page_size:
+                with dtrace.use(ctx), self._span(bucket=bucket,
+                                                 worker=self.name):
+                    self._one_streamed(rid, req, padded,
+                                       int(prompt.size), bucket,
+                                       key, ctx)
+                return
             with dtrace.use(ctx), self._span(bucket=bucket,
                                              worker=self.name):
                 tok, kb, vb, rng = self._fn(bucket)(
@@ -723,6 +838,103 @@ class PrefillWorker:
                 if self.on_fail is not None:
                     self.on_fail(rid, "error")
 
+    def _one_streamed(self, rid: int, req: Request, padded, true_len,
+                      bucket: int, key, ctx) -> None:
+        """Streamed prefill: run the prompt in ``stream_chunk``-wide
+        slices of :func:`llama.prefill_detached_chunk` and ship each
+        chunk's kvpage frames from a dedicated SHIPPER thread while
+        the compute loop moves on to the next chunk. The thread is
+        what makes the overlap real: host gather, wire serialize and
+        NIC occupancy all release the GIL, and the compute loop never
+        waits on the wire even where the backend's dispatch is
+        synchronous (CPU). Bit-identical to the one-shot path: same
+        causal math per position, same single rng split (the chunk
+        program's contract), same wire frames in the same order —
+        only their timing changes. The closing kvdone is sent after
+        the shipper drains, and carries the final chunk's token/rng
+        and the trace context, exactly like the one-shot sender."""
+        ps = int(self.wire_page_size)
+        cw = min(self.stream_chunk, bucket)
+        # every page that carries prompt tokens, capped at the bucket
+        # (same trim rule as handoff_to_page_frames)
+        n_send = min(bucket, -(-true_len // ps) * ps)
+        cfg = self.cfg
+        shape = (cfg.n_layers, 1, cfg.n_kv_heads, bucket,
+                 cfg.head_dim)
+        # two distinct buffers: the chunk program donates the cache,
+        # and one zeros array aliased as both k and v cannot be
+        # donated twice
+        cache = {"k": jax.numpy.zeros(shape, cfg.dtype),
+                 "v": jax.numpy.zeros(shape, cfg.dtype),
+                 "pos": jax.numpy.zeros((), jax.numpy.int32)}
+        V = cfg.vocab_size
+        temp = np.float32(req.temperature)
+        tk = np.int32(V if req.top_k is None else req.top_k)
+        tp = np.float32(1.0 if req.top_p is None else req.top_p)
+        tok = rng_out = None
+        # unbounded on purpose: worst case it holds the full block on
+        # host, exactly what the one-shot gather does anyway — and an
+        # unbounded put can never deadlock against a dead shipper
+        todo: "queue.Queue" = queue.Queue()
+        shipped = []
+        fault: list = []
+
+        def _shipper():
+            while True:
+                item = todo.get()
+                if item is None:
+                    return
+                try:
+                    shipped.append(
+                        self._ship_pages(rid, *item, n_send))
+                except BaseException as e:      # noqa: BLE001 — must
+                    fault.append(e)             # cross the thread seam
+                    return
+
+        shipper = threading.Thread(target=_shipper, daemon=True,
+                                   name="mxtpu-kv-shipper")
+        shipper.start()
+        for pos in range(0, n_send, cw):
+            t, kc, vc, r, cache = self._chunk_fn(bucket)(
+                self.params, padded[:, pos:pos + cw], cache,
+                np.int32(true_len), key, temp, tk, tp)
+            if pos <= true_len - 1 < pos + cw:
+                tok, rng_out = t, r
+            todo.put((pos, kc, vc))
+        todo.put(None)
+        shipper.join()
+        if fault:
+            raise fault[0]
+        n_frames = sum(shipped)
+        telemetry.counter(
+            "gateway_prefill_stream_jobs_total",
+            "Prefill jobs served by the streamed (chunked) path").inc()
+        done = ("kvdone", int(rid), int(true_len),
+                int(np.asarray(tok)[0]),
+                np.asarray(rng_out, np.uint32), n_frames)
+        if ctx is not None:
+            done = rpc.attach_context(done, ctx.to_wire())
+        self.channel.send_handoff(done)
+
+    def _ship_pages(self, rid: int, pos: int, kc, vc,
+                    n_send: int) -> int:
+        """Host-gather one computed chunk and send a kvpage frame per
+        page it fills (short final page when the bucket is smaller
+        than a page, same as the one-shot encoder). Returns the frame
+        count."""
+        k, v = np.asarray(kc), np.asarray(vc)
+        ps = int(self.wire_page_size)
+        sent = 0
+        for off in range(0, k.shape[2], ps):
+            if pos + off >= n_send:
+                break
+            end = min(off + ps, n_send - pos)
+            self.channel.send_handoff(
+                ("kvpage", int(rid), (pos + off) // ps,
+                 k[:, :, off:end], v[:, :, off:end]))
+            sent += 1
+        return sent
+
 
 class DisaggBackend:
     """Prefill pool + decode replicas + the feeder joining them — the
@@ -744,7 +956,8 @@ class DisaggBackend:
                  n_pages: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  int8_pages: Optional[bool] = None,
-                 kv_journal: Optional[int] = None):
+                 kv_journal: Optional[int] = None,
+                 stream_chunk: Optional[int] = None):
         max_len = int(max_len or cfg.max_seq_len)
         min_bucket = int(min_bucket or 16)
         self._cfg = cfg
@@ -768,9 +981,11 @@ class DisaggBackend:
                                 prefix_cache=prefix_cache,
                                 int8_pages=int8_pages),
             n_decode, started=started)
-        # feeder-thread-only reassembly buffers: rid -> {chunk: (k,v)}
-        self._parts: Dict[int, Dict[int, Tuple[np.ndarray,
-                                               np.ndarray]]] = {}
+        # feeder-thread-only reassembly buffers: rid -> _PageBuffer
+        # (each kvpage frame is copied into the buffer on arrival, so
+        # seating at kvdone does no assembly work)
+        self._parts: Dict[int, _PageBuffer] = {}
+        self._stream_chunk = stream_chunk
         # KV journal (paged re-dispatch seam): the last N seated
         # handoffs, keyed by their prompt tokens — a crash re-dispatch
         # whose prompt EXTENDS a journaled one re-seats the pages and
@@ -831,7 +1046,8 @@ class DisaggBackend:
             min_bucket=self._min_bucket, max_len=self._mlen,
             mesh=self._mesh, name=f"p{next(self._wseq)}",
             on_fail=self._fail_pending,
-            wire_page_size=self._wire_ps)
+            wire_page_size=self._wire_ps,
+            stream_chunk=self._stream_chunk)
 
     def _fail_pending(self, rid: int, reason: str = "error") -> None:
         """Finalize a pending request whose prefill/handoff failed
@@ -1079,17 +1295,27 @@ class DisaggBackend:
                 continue
             if (isinstance(msg, tuple) and len(msg) == 5
                     and msg[0] == "kvpage"):
-                # one page of an in-flight handoff: buffer by chunk
-                # index (idempotent — a resent chunk overwrites itself)
-                self._parts.setdefault(
-                    int(msg[1]), {})[int(msg[2])] = (msg[3], msg[4])
+                # one page of an in-flight handoff: copied into the
+                # rid's assembly buffer NOW (idempotent — a resent
+                # chunk overwrites itself in place), so seating at
+                # kvdone starts from a finished block
+                try:
+                    self._parts.setdefault(
+                        int(msg[1]), _PageBuffer()).add(
+                            int(msg[2]), msg[3], msg[4])
+                except rpc.RPCProtocolError as e:
+                    telemetry.flight().record(
+                        "gateway", "kv_channel_error",
+                        error=repr(e)[:200])
+                    return
                 self._m_page_frames.inc()
                 continue
             try:
                 if (isinstance(msg, tuple) and msg
                         and msg[0] == "kvdone"):
-                    rid, handoff = pages_to_handoff(
-                        msg, self._parts.pop(int(msg[1]), {}))
+                    buf = self._parts.pop(int(msg[1]), None)
+                    rid, handoff = (buf if buf is not None
+                                    else _PageBuffer()).finish(msg)
                 else:
                     rid, handoff = wire_to_handoff(msg)
             except rpc.RPCProtocolError as e:

@@ -349,6 +349,23 @@ class Gateway:
             "burn rate is over threshold (tier-3 shed: low-priority "
             "work yields chips to interactive under burn); 0 "
             "disables."))
+        # prefix-page affinity: a prompt whose head extends a prefix
+        # some replica's paged cache already holds routes to THAT
+        # replica (a CoW fork of warm pages beats a cold prefill on a
+        # least-loaded one). Consulted only when nothing upstream set
+        # prefer_replica — the fleet session map wins when it hits.
+        self._prefix_affinity = env_int(
+            "MXTPU_GATEWAY_PREFIX_AFFINITY", 4,
+            "Minimum tokens of a prompt's head that must match a "
+            "replica's cached prefix (the top_prefixes head in its "
+            "kv_cache stats) before the gateway steers the request to "
+            "that replica instead of the least-loaded one; 0 disables "
+            "prefix-page affinity.")
+        self._aff_lock = threading.Lock()   # scrape cache + tally only
+        self._aff_scrape: tuple = (None, [])  # (monotonic ts, rows)
+        self._aff_ttl = 0.25
+        self._aff_tally: Dict[str, int] = {"hit": 0, "miss": 0}
+        self._m_aff: Dict[str, Any] = {}
         # metrics federation: peer processes (prefill workers on
         # other hosts, a kvstore server, sibling replicas) exposing
         # their registry via telemetry.RegistryServer; this gateway's
@@ -471,6 +488,70 @@ class Gateway:
                 "yield under SLO burn)",
                 priority=priority, tier=str(tier), **self._mlabels)
         m.inc()
+
+    def _count_aff(self, result: str) -> None:
+        m = self._m_aff.get(result)
+        if m is None:
+            m = self._m_aff[result] = telemetry.counter(
+                "gateway_prefix_affinity_total",
+                "Prefix-page affinity consults at the gateway, by "
+                "result (hit: some replica's paged cache holds a "
+                "prefix this prompt extends, and the request was "
+                "steered to that replica)",
+                result=result, **self._mlabels)
+        m.inc()
+        with self._aff_lock:
+            self._aff_tally[result] = (
+                self._aff_tally.get(result, 0) + 1)
+
+    def prefix_prefer(self, prompt) -> Optional[str]:
+        """The prefix-page affinity probe: the name of the healthy
+        replica whose paged cache holds the longest cached prefix
+        this prompt extends (at least ``MXTPU_GATEWAY_PREFIX_AFFINITY``
+        shared tokens), or None. Matching is against each replica's
+        ``top_prefixes`` heads from ``backend.state()`` — scraped at
+        most once per ``_aff_ttl`` seconds, so the per-route cost is a
+        cached list scan. Best-effort by construction: heads carry
+        only the first 8 prefix tokens, and routing falls back to
+        least-loaded silently when the preferred replica is gone
+        (:meth:`ReplicaSet.route`). ``submit`` consults this whenever
+        no explicit ``prefer_replica`` arrives; the fleet router
+        consults it when its session map misses."""
+        if (not self._prefix_affinity
+                or not isinstance(self.backend, ReplicaSet)):
+            return None
+        p = [int(t) for t in
+             np.asarray(prompt, np.int32).reshape(-1)[:64]]
+        if len(p) < self._prefix_affinity:
+            return None
+        now = self._clock()
+        with self._aff_lock:
+            ts, rows = self._aff_scrape
+        if ts is None or now - ts >= self._aff_ttl:
+            try:
+                rows = self.backend.state()
+            except RuntimeError:       # racing close(): no affinity
+                rows = []
+            with self._aff_lock:
+                self._aff_scrape = (now, rows)
+        best = None                    # ((score, hits), name)
+        for row in rows:
+            if not row.get("healthy"):
+                continue
+            kc = row.get("kv_cache") or {}
+            for e in kc.get("top_prefixes") or []:
+                h = [int(t) for t in (e.get("head") or [])]
+                if not h or len(p) < len(h) or p[:len(h)] != h:
+                    continue
+                # the true shared run is at least len(h); up to
+                # n_tokens of it can be reused, capped by the prompt
+                score = min(int(e.get("n_tokens", len(h))), len(p))
+                if score < self._prefix_affinity:
+                    continue
+                key = (score, int(e.get("hits", 0)))
+                if best is None or key > best[0]:
+                    best = (key, row["name"])
+        return best[1] if best else None
 
     def _retry_after(self, base: int) -> int:
         """Jittered Retry-After: base plus a seeded uniform draw in
@@ -595,6 +676,13 @@ class Gateway:
                 handle._entry = entry
                 self._journal[entry.gid] = entry
             req = self._build_request(entry, deadline_s=deadline)
+            if (prefer_replica is None and self._prefix_affinity
+                    and isinstance(self.backend, ReplicaSet)):
+                # no upstream affinity decision: prefer the replica
+                # whose paged cache already holds this prompt's head
+                prefer_replica = self.prefix_prefer(entry.prompt)
+                self._count_aff("hit" if prefer_replica is not None
+                                else "miss")
             # affinity only applies to ReplicaSet-style backends (a
             # disagg backend's route has no prefer surface); passed
             # conditionally so other backends need no signature change
@@ -1012,7 +1100,14 @@ class Gateway:
             tops = [p for r in paged_rows
                     for p in r.get("top_prefixes", [])]
             tops.sort(key=lambda p: -p.get("hits", 0))
+            # speculative-decode acceptance, fleet-wide (per-replica
+            # rates stay in each replica row's kv_cache — diagnose kv
+            # renders both from this one scrape)
+            prop = sum(r.get("spec_proposed", 0) for r in paged_rows)
+            acc = sum(r.get("spec_accepted", 0) for r in paged_rows)
             kv_cache.update({
+                "spec_proposed": prop, "spec_accepted": acc,
+                "spec_accept_rate": (acc / prop) if prop else 0.0,
                 "paged": True,
                 "pages_total": sum(r.get("pages_total", 0)
                                    for r in paged_rows),
@@ -1028,10 +1123,13 @@ class Gateway:
                 "prefix_hit_rate": (hits / (hits + misses)
                                     if hits + misses else 0.0),
                 "top_prefixes": tops[:5]})
+        with self._aff_lock:
+            aff = dict(self._aff_tally)
         return {"replicas": replicas,
                 "kv_cache": kv_cache,
                 "n_replicas": self.backend.size,
                 "model": self.model,
+                "prefix_affinity": aff,
                 "priority_mix": dict(self.priority_tally),
                 "queued": load["queued"], "active": load["active"],
                 "slots": load["slots"], "queue_max": self.queue_max,

@@ -206,6 +206,49 @@ def test_decode_raise_restart_history_and_state(cfg, params):
         gw.close()
 
 
+@pytest.mark.slow   # ~20s (spec engines recompile on the respawned
+# replica); CI home: chaos_serve — tier-1 keeps the rng-advance gate
+# in tests/test_spec_decode.py and the fresh-process spec_smoke stage
+def test_replica_kill_mid_speculative_run_bit_identical(cfg, params):
+    """ISSUE 19: a replica dies MID-ACCEPTED-RUN — the journaled
+    emitted prefix was produced by multi-token speculative steps, so
+    the re-dispatch must fast-forward the rng chain by the EMITTED
+    count (one split per valid token), not by decode steps. The
+    plateau prompt keeps speculation firing (multi-token advance before
+    the kill); the sampled request observes every split position."""
+    reg = telemetry.registry()
+    rd0 = reg.value("gateway_redispatch_total")
+    gw = Gateway(lambda: _engine(cfg, params, paged=True, page_size=8,
+                                 speculate_k=3),
+                 n_replicas=1, queue_max=16, supervisor_opts=SUP)
+    plan = attach_serve(gw, ServeChaosPlan(
+        seed=5, raise_in_decode={0: 3}))    # dies on its 3rd step
+    try:
+        jobs = [dict(prompt=[140, 141, 140], mnew=12,
+                     temperature=0.0, seed=0),
+                dict(prompt=[9, 4, 7, 1, 6], mnew=8,
+                     temperature=0.9, top_k=7, seed=6)]
+        hs = [gw.submit(j["prompt"], j["mnew"], seed=j["seed"],
+                        temperature=j["temperature"],
+                        **({"top_k": j["top_k"]} if "top_k" in j
+                           else {}))
+              for j in jobs]
+        for h, j in zip(hs, jobs):
+            toks = h.result(timeout=180)
+            assert h.reason == "complete", j
+            assert list(toks) == _reference(
+                cfg, params, j["prompt"], j["mnew"], seed=j["seed"],
+                temperature=j["temperature"],
+                top_k=j.get("top_k")), j
+        assert plan.injected["decode_raise"] == 1
+        assert reg.value("gateway_redispatch_total") - rd0 >= 1
+        # the replica was speculating when it died AND after respawn
+        st = gw.state()
+        assert any(r["healthy"] for r in st["replicas"])
+    finally:
+        gw.close()
+
+
 def test_zero_healthy_replicas_503_and_parked_failure(cfg, params):
     """Restart budget 0 + a dead only-replica: new submissions get the
     DISTINCT unavailable error (HTTP 503 + Retry-After), the stranded

@@ -125,7 +125,8 @@ def gateway_state(addr: str = ""):
 def kv_state(addr: str = ""):
     """``python tools/diagnose.py kv <host:port>`` — the paged-KV
     view of a running gateway, from ONE GET /state scrape: page-pool
-    occupancy, shared pages, prefix-cache hit rate, and the top shared
+    occupancy, shared pages, prefix-cache hit rate, speculative-decode
+    acceptance, prefix-affinity routing counts, and the top shared
     prefixes, fleet-aggregated and then per decode replica."""
     addr = addr or os.environ.get("MXTPU_GATEWAY_ADDR", "")
     if not addr:
@@ -164,6 +165,16 @@ def kv_state(addr: str = ""):
           f"cow_forks={kv.get('cow_forks', 0)}")
     print(f"prefix cache: hits={hits} misses={misses} "
           f"hit_rate={rate:.3f}")
+    if kv.get("spec_proposed", 0):
+        print(f"speculative: proposed={kv.get('spec_proposed', 0)} "
+              f"accepted={kv.get('spec_accepted', 0)} "
+              f"accept_rate={kv.get('spec_accept_rate', 0.0):.3f}")
+    aff = state.get("prefix_affinity") or {}
+    if aff.get("hit", 0) or aff.get("miss", 0):
+        tot = aff.get("hit", 0) + aff.get("miss", 0)
+        print(f"prefix affinity: hits={aff.get('hit', 0)} "
+              f"misses={aff.get('miss', 0)} "
+              f"hit_rate={aff.get('hit', 0) / tot:.3f}")
     for p in kv.get("top_prefixes", []):
         print(f"  prefix len={p.get('n_tokens')} "
               f"hits={p.get('hits')} pages={p.get('pages')} "
@@ -172,13 +183,15 @@ def kv_state(addr: str = ""):
         rkv = r.get("kv_cache") if isinstance(r, dict) else None
         if not rkv or not rkv.get("paged"):
             continue
+        spec = (f"accept={rkv.get('spec_accept_rate', 0.0):.2f} "
+                if rkv.get("speculate_k") else "")
         print(f"  {r.get('name', '?'):<10} "
               f"pages={rkv.get('pages_used', 0)}"
               f"/{rkv.get('pages_total', 0)} "
               f"shared={rkv.get('pages_shared', 0)} "
               f"hits={rkv.get('prefix_hits', 0)} "
               f"misses={rkv.get('prefix_misses', 0)} "
-              f"cow={rkv.get('cow_forks', 0)} "
+              f"cow={rkv.get('cow_forks', 0)} " + spec +
               f"entries={rkv.get('prefix_entries', 0)}")
     return True
 
